@@ -1,0 +1,55 @@
+"""sheeprl_tpu: a TPU-native (JAX/XLA/pjit/pallas) deep-RL training framework
+with the capabilities of SheepRL (reference: /root/reference).
+
+Importing the package eagerly imports every algorithm train/eval module so
+decorator registration fires (reference /root/reference/sheeprl/__init__.py:18-56).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+__version__ = "0.1.0"
+
+_ALGO_MODULES = [
+    "sheeprl_tpu.algos.ppo.ppo",
+    "sheeprl_tpu.algos.ppo.ppo_decoupled",
+    "sheeprl_tpu.algos.ppo.evaluate",
+    "sheeprl_tpu.algos.a2c.a2c",
+    "sheeprl_tpu.algos.a2c.evaluate",
+    "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
+    "sheeprl_tpu.algos.ppo_recurrent.evaluate",
+    "sheeprl_tpu.algos.sac.sac",
+    "sheeprl_tpu.algos.sac.sac_decoupled",
+    "sheeprl_tpu.algos.sac.evaluate",
+    "sheeprl_tpu.algos.sac_ae.sac_ae",
+    "sheeprl_tpu.algos.sac_ae.evaluate",
+    "sheeprl_tpu.algos.droq.droq",
+    "sheeprl_tpu.algos.droq.evaluate",
+    "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",
+    "sheeprl_tpu.algos.dreamer_v1.evaluate",
+    "sheeprl_tpu.algos.dreamer_v2.dreamer_v2",
+    "sheeprl_tpu.algos.dreamer_v2.evaluate",
+    "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
+    "sheeprl_tpu.algos.dreamer_v3.evaluate",
+    "sheeprl_tpu.algos.dreamer_v3_jepa.dreamer_v3_jepa",
+    "sheeprl_tpu.algos.dreamer_v3_jepa.evaluate",
+    "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_exploration",
+    "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_finetuning",
+    "sheeprl_tpu.algos.p2e_dv1.evaluate",
+    "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_exploration",
+    "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_finetuning",
+    "sheeprl_tpu.algos.p2e_dv2.evaluate",
+    "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration",
+    "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_finetuning",
+    "sheeprl_tpu.algos.p2e_dv3.evaluate",
+]
+
+for _mod in _ALGO_MODULES:
+    try:
+        importlib.import_module(_mod)
+    except ModuleNotFoundError as err:
+        # during the incremental build not every algorithm exists yet;
+        # tolerate only missing in-package modules, never real import errors
+        if not str(err.name or "").startswith("sheeprl_tpu"):
+            raise

@@ -1,0 +1,376 @@
+"""Hydra-compatible YAML config composition, self-contained.
+
+The reference drives everything through Hydra 1.3 (see
+/root/reference/sheeprl/configs/config.yaml and /root/reference/sheeprl/cli.py:358-366).
+Hydra/OmegaConf are not available in this image, so this module implements the
+subset of Hydra semantics the config tree actually uses:
+
+- a root ``config.yaml`` with a ``defaults`` list of ``group: option`` entries;
+- group config files, each optionally with its own ``defaults`` list supporting
+  relative entries (``- default``), absolute entries with package relocation
+  (``- /optim@optimizer: adam``) and ``- _self_`` ordering;
+- ``# @package _global_`` experiment overlays with ``override /group: option``;
+- CLI overrides: ``group=option`` to pick a group file, ``a.b.c=value`` for
+  dotted value overrides (``+a.b=v`` also accepted);
+- ``${a.b}`` absolute interpolation and the ``${now:%fmt}`` resolver;
+- ``???`` mandatory-value markers (validated eagerly after composition);
+- ``_target_``-based recursive instantiation (:func:`instantiate`).
+
+A ``SHEEPRL_SEARCH_PATH``-style extension point is kept: the env var
+``SHEEPRL_TPU_SEARCH_PATH`` may hold a ``:``-separated list of extra config
+directories searched *before* the built-in tree (mirrors
+/root/reference/hydra_plugins/sheeprl_search_path.py:10-33).
+"""
+
+from __future__ import annotations
+
+import datetime
+import importlib
+import os
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import yaml
+
+from sheeprl_tpu.utils.utils import dotdict
+
+CONFIG_DIR = Path(__file__).parent / "configs"
+_INTERP_RE = re.compile(r"\$\{([^${}]+)\}")
+
+
+class ConfigError(RuntimeError):
+    pass
+
+
+def _search_dirs(extra_dirs: Optional[Sequence[str]] = None) -> List[Path]:
+    dirs: List[Path] = []
+    env_paths = os.environ.get("SHEEPRL_TPU_SEARCH_PATH", "")
+    for p in list(extra_dirs or []) + [d for d in env_paths.split(":") if d]:
+        p = Path(p)
+        if p.is_dir():
+            dirs.append(p)
+    dirs.append(CONFIG_DIR)
+    return dirs
+
+
+def _find_config_file(group: str, option: str, dirs: Sequence[Path]) -> Path:
+    option = option[:-5] if option.endswith(".yaml") else option
+    for d in dirs:
+        candidate = d / group / f"{option}.yaml"
+        if candidate.is_file():
+            return candidate
+    raise ConfigError(f"Config '{group}/{option}.yaml' not found in {[str(d) for d in dirs]}")
+
+
+def _load_yaml(path: Path) -> Tuple[Dict[str, Any], bool]:
+    """Load a YAML file. Returns (content, is_global_package)."""
+    text = path.read_text()
+    is_global = False
+    for line in text.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("# @package"):
+            is_global = "_global_" in stripped
+            break
+        if stripped and not stripped.startswith("#"):
+            break
+    data = yaml.safe_load(text) or {}
+    if not isinstance(data, dict):
+        raise ConfigError(f"Top-level YAML in {path} must be a mapping")
+    return data, is_global
+
+
+def deep_merge(base: Dict[str, Any], overlay: Mapping[str, Any]) -> Dict[str, Any]:
+    """Merge ``overlay`` into ``base`` (dicts merge recursively, rest replaces)."""
+    for k, v in overlay.items():
+        if isinstance(v, Mapping) and isinstance(base.get(k), dict):
+            deep_merge(base[k], v)
+        else:
+            base[k] = v.copy() if isinstance(v, dict) else (list(v) if isinstance(v, list) else v)
+    return base
+
+
+def _compose_group_file(group: str, option: str, dirs: Sequence[Path]) -> Dict[str, Any]:
+    """Load a group option, recursively resolving its own defaults list."""
+    path = _find_config_file(group, option, dirs)
+    data, _ = _load_yaml(path)
+    defaults = data.pop("defaults", None)
+    if defaults is None:
+        return data
+    result: Dict[str, Any] = {}
+    self_merged = False
+    for entry in defaults:
+        if entry == "_self_":
+            deep_merge(result, data)
+            self_merged = True
+        elif isinstance(entry, str):
+            # relative entry within the same group
+            deep_merge(result, _compose_group_file(group, entry, dirs))
+        elif isinstance(entry, dict):
+            for key, value in entry.items():
+                key = str(key)
+                if key.startswith("override"):
+                    raise ConfigError(f"'override' not valid inside group file {path}")
+                pkg = None
+                src = key
+                if "@" in key:
+                    src, pkg = key.split("@", 1)
+                src = src.lstrip("/")
+                sub = _compose_group_file(src, str(value), dirs)
+                if pkg is None or pkg == "_here_":
+                    deep_merge(result, sub)
+                elif pkg == "_global_":
+                    deep_merge(result, sub)
+                else:
+                    node = result
+                    for part in pkg.split("."):
+                        node = node.setdefault(part, {})
+                    deep_merge(node, sub)
+        else:
+            raise ConfigError(f"Unsupported defaults entry {entry!r} in {path}")
+    if not self_merged:
+        deep_merge(result, data)
+    return result
+
+
+def _parse_overrides(overrides: Sequence[str]) -> Tuple[Dict[str, str], Dict[str, Any]]:
+    """Split CLI overrides into group selections and dotted value overrides."""
+    group_sel: Dict[str, str] = {}
+    dotted: Dict[str, Any] = {}
+    for ov in overrides:
+        if "=" not in ov:
+            raise ConfigError(f"Override '{ov}' is not of the form key=value")
+        key, _, value = ov.partition("=")
+        key = key.lstrip("+~")
+        parsed = yaml.safe_load(value) if value != "" else None
+        if "." not in key and (CONFIG_DIR / key).is_dir():
+            group_sel[key] = str(value)
+        else:
+            dotted[key] = parsed
+    return group_sel, dotted
+
+
+def _set_dotted(cfg: Dict[str, Any], key: str, value: Any) -> None:
+    node = cfg
+    parts = key.split(".")
+    for part in parts[:-1]:
+        nxt = node.get(part)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            node[part] = nxt
+        node = nxt
+    node[parts[-1]] = value
+
+
+def _get_dotted(cfg: Mapping[str, Any], key: str) -> Any:
+    node: Any = cfg
+    for part in key.split("."):
+        if not isinstance(node, Mapping) or part not in node:
+            raise KeyError(key)
+        node = node[part]
+    return node
+
+
+def _resolve_value(value: Any, root: Mapping[str, Any], depth: int = 0) -> Any:
+    if depth > 20:
+        raise ConfigError(f"Interpolation loop while resolving {value!r}")
+    if not isinstance(value, str):
+        return value
+    matches = list(_INTERP_RE.finditer(value))
+    if not matches:
+        return value
+
+    def repl(expr: str) -> Any:
+        if expr.startswith("now:"):
+            return datetime.datetime.now().strftime(expr[4:])
+        if expr.startswith("oc.env:") or expr.startswith("env:"):
+            parts = expr.split(":", 1)[1].split(",", 1)
+            return os.environ.get(parts[0], parts[1] if len(parts) > 1 else "")
+        if expr.startswith("eval:"):
+            raise ConfigError("eval resolver not supported")
+        return _resolve_value(_get_dotted(root, expr), root, depth + 1)
+
+    if len(matches) == 1 and matches[0].span() == (0, len(value)):
+        try:
+            return repl(matches[0].group(1))
+        except KeyError:
+            return value
+    out = value
+    for m in matches:
+        try:
+            out = out.replace(m.group(0), str(repl(m.group(1))))
+        except KeyError:
+            pass
+    return out
+
+
+def resolve_interpolations(cfg: Dict[str, Any], root: Optional[Mapping[str, Any]] = None) -> Dict[str, Any]:
+    root = root if root is not None else cfg
+
+    def walk(node: Any) -> Any:
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return _resolve_value(node, root)
+
+    return walk(cfg)
+
+
+def _missing_keys(cfg: Mapping[str, Any], prefix: str = "") -> List[str]:
+    missing = []
+    for k, v in cfg.items():
+        path = f"{prefix}{k}"
+        if isinstance(v, Mapping):
+            missing.extend(_missing_keys(v, path + "."))
+        elif isinstance(v, str) and v == "???":
+            missing.append(path)
+    return missing
+
+
+def compose(
+    overrides: Sequence[str] = (),
+    config_name: str = "config",
+    extra_dirs: Optional[Sequence[str]] = None,
+    check_missing: bool = True,
+) -> dotdict:
+    """Compose the full config tree the way ``@hydra.main`` does in the
+    reference CLI (/root/reference/sheeprl/cli.py:358-366)."""
+    dirs = _search_dirs(extra_dirs)
+    root_path = None
+    for d in dirs:
+        cand = d / f"{config_name}.yaml"
+        if cand.is_file():
+            root_path = cand
+            break
+    if root_path is None:
+        raise ConfigError(f"Root config '{config_name}.yaml' not found")
+    root_data, _ = _load_yaml(root_path)
+    root_defaults = root_data.pop("defaults", [])
+
+    group_sel, dotted = _parse_overrides(overrides)
+
+    # Pass 1: figure out which option each group uses.
+    selections: Dict[str, str] = {}
+    order: List[str] = []
+    self_first = True
+    seen_self = False
+    for entry in root_defaults:
+        if entry == "_self_":
+            seen_self = True
+            continue
+        if isinstance(entry, dict):
+            for g, opt in entry.items():
+                g = str(g)
+                selections[g] = str(opt)
+                order.append(g)
+            if not seen_self:
+                self_first = False
+    selections.update(group_sel)
+    for g in group_sel:
+        if g not in order:
+            order.append(g)
+
+    # Experiment overlays are @package _global_ and may override group choices.
+    exp_entries: List[Tuple[str, Dict[str, Any]]] = []
+    for g in list(order):
+        opt = selections.get(g, "???")
+        if opt == "???":
+            continue
+        path_try = None
+        try:
+            path_try = _find_config_file(g, opt, dirs)
+        except ConfigError:
+            raise
+        _, is_global = _load_yaml(path_try)
+        if is_global and g in ("exp",):
+            data, _ = _load_yaml(path_try)
+            for d_entry in data.get("defaults", []):
+                if isinstance(d_entry, dict):
+                    for key, value in d_entry.items():
+                        key = str(key)
+                        if key.startswith("override"):
+                            target = key.split("/", 1)[1].strip()
+                            # CLI group selections beat the experiment file
+                            if target not in group_sel:
+                                selections[target] = str(value)
+            exp_entries.append((g, data))
+
+    missing_groups = [g for g in order if selections.get(g) == "???" and g not in ("exp",)]
+    if selections.get("exp") == "???" and not any(g == "exp" for g, _ in exp_entries):
+        if "exp" in order and "algo" in group_sel:
+            selections.pop("exp", None)
+            order.remove("exp")
+        elif "exp" in order:
+            raise ConfigError("You must specify an experiment: add exp=<name> (e.g. exp=ppo)")
+
+    cfg: Dict[str, Any] = {}
+    if self_first:
+        deep_merge(cfg, root_data)
+    for g in order:
+        opt = selections.get(g)
+        if opt is None or opt == "???":
+            continue
+        if g == "exp":
+            continue  # merged last, at global package
+        try:
+            sub = _compose_group_file(g, opt, dirs)
+        except ConfigError:
+            if g in ("hydra",):  # hydra's own runtime group is not used in this build
+                continue
+            raise
+        deep_merge(cfg.setdefault(g, {}), sub)
+    if not self_first:
+        deep_merge(cfg, root_data)
+    if missing_groups:
+        pass  # groups left '???' are tolerated until value validation below
+
+    # Experiment overlay at _global_ package (minus its defaults list).
+    for _, data in exp_entries:
+        overlay = {k: v for k, v in data.items() if k != "defaults"}
+        deep_merge(cfg, overlay)
+
+    # Dotted CLI overrides win over everything.
+    for key, value in dotted.items():
+        _set_dotted(cfg, key, value)
+
+    cfg = resolve_interpolations(cfg)
+    if check_missing:
+        missing = _missing_keys(cfg)
+        if missing:
+            raise ConfigError(f"Mandatory config values left unset (???): {missing}")
+    return dotdict(cfg)
+
+
+def instantiate(node: Mapping[str, Any] | Any, *args: Any, **kwargs: Any) -> Any:
+    """Recursive ``_target_`` instantiation (Hydra's ``hydra.utils.instantiate``).
+
+    ``_partial_: true`` returns a ``functools.partial`` instead of calling.
+    """
+    import functools
+
+    if not isinstance(node, Mapping) or "_target_" not in node:
+        return node
+    target = node["_target_"]
+    module_name, _, attr = target.rpartition(".")
+    obj = getattr(importlib.import_module(module_name), attr)
+    call_kwargs: Dict[str, Any] = {}
+    for k, v in node.items():
+        if k in ("_target_", "_partial_", "_convert_"):
+            continue
+        if isinstance(v, Mapping) and "_target_" in v:
+            call_kwargs[k] = instantiate(v)
+        elif isinstance(v, list):
+            call_kwargs[k] = [instantiate(item) if isinstance(item, Mapping) and "_target_" in item else item for item in v]
+        else:
+            call_kwargs[k] = v
+    call_kwargs.update(kwargs)
+    if node.get("_partial_", False):
+        return functools.partial(obj, *args, **call_kwargs)
+    return obj(*args, **call_kwargs)
+
+
+def get_callable(target: str) -> Any:
+    """Import ``module.attr`` from a dotted string (for activation fns etc.)."""
+    module_name, _, attr = target.rpartition(".")
+    return getattr(importlib.import_module(module_name), attr)

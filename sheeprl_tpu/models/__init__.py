@@ -1,0 +1,11 @@
+from sheeprl_tpu.models.blocks import (
+    CNN,
+    DeCNN,
+    LayerNormGRUCell,
+    MLP,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+)
+
+__all__ = ["CNN", "DeCNN", "LayerNormGRUCell", "MLP", "MultiDecoder", "MultiEncoder", "NatureCNN"]

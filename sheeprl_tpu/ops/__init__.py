@@ -1,0 +1,23 @@
+from sheeprl_tpu.ops.numerics import (
+    gae,
+    compute_lambda_values,
+    safeatanh,
+    safetanh,
+    symexp,
+    symlog,
+    two_hot_decoder,
+    two_hot_encoder,
+    uniform_mix,
+)
+
+__all__ = [
+    "gae",
+    "compute_lambda_values",
+    "safeatanh",
+    "safetanh",
+    "symexp",
+    "symlog",
+    "two_hot_decoder",
+    "two_hot_encoder",
+    "uniform_mix",
+]

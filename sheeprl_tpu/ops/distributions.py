@@ -1,0 +1,408 @@
+"""JAX distribution library.
+
+Replaces the reference's torch.distributions subclasses
+(/root/reference/sheeprl/utils/distribution.py:25-416) with lightweight pure
+classes over ``jax.Array``.  Every object here is safe to construct *inside* a
+jitted function: construction does no host work, sampling takes an explicit
+PRNG key, and gradients flow through ``rsample``-style reparameterization or
+straight-through estimators built on ``stop_gradient``.
+
+Conventions:
+- ``sample(key)`` draws without gradient; ``rsample(key)`` reparameterizes.
+- ``log_prob(x)`` sums over declared event dims (like torch's Independent).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.ops.numerics import safeatanh, safetanh, symexp, symlog
+
+
+def _sum_last_dims(x: jax.Array, dims: int) -> jax.Array:
+    if dims == 0:
+        return x
+    return jnp.sum(x, axis=tuple(range(-dims, 0)))
+
+
+class Normal:
+    """Diagonal normal with optional event dims (Independent(Normal, dims))."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, event_dims: int = 0):
+        self.loc = loc
+        self.scale = scale
+        self.event_dims = event_dims
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def stddev(self) -> jax.Array:
+        return self.scale
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        eps = jax.random.normal(key, self.loc.shape, dtype=self.loc.dtype)
+        return self.loc + self.scale * eps
+
+    sample = rsample
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        var = self.scale**2
+        lp = -((value - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+        return _sum_last_dims(lp, self.event_dims)
+
+    def entropy(self) -> jax.Array:
+        ent = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _sum_last_dims(ent, self.event_dims)
+
+
+class TanhNormal:
+    """Squashed diagonal Gaussian (SAC actor).  The log-prob uses the
+    tanh change-of-variables with the numerically-safe atanh of the reference
+    (utils/utils.py:303-316, algos/sac/agent.py squashed log-prob)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, event_dims: int = 1, eps: float = 1e-6):
+        self.base = Normal(loc, scale, event_dims=0)
+        self.event_dims = event_dims
+        self.eps = eps
+
+    @property
+    def mean(self) -> jax.Array:
+        return jnp.tanh(self.base.loc)
+
+    mode = mean
+
+    def rsample_and_log_prob(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = self.base.rsample(key)
+        y = safetanh(x, self.eps)
+        lp = self.base.log_prob(x) - jnp.log1p(-(y**2) + self.eps)
+        return y, _sum_last_dims(lp, self.event_dims)
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        return self.rsample_and_log_prob(key)[0]
+
+    sample = rsample
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        x = safeatanh(value, self.eps)
+        lp = self.base.log_prob(x) - jnp.log1p(-(value**2) + self.eps)
+        return _sum_last_dims(lp, self.event_dims)
+
+
+class TruncatedNormal:
+    """Truncated normal on [a, b] with reparameterized sampling
+    (reference distribution.py:25-149, DreamerV1/V2 continuous actor)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, a: float = -1.0, b: float = 1.0, event_dims: int = 1):
+        self.loc = loc
+        self.scale = scale
+        self.a = a
+        self.b = b
+        self.event_dims = event_dims
+        self._alpha = (a - loc) / scale
+        self._beta = (b - loc) / scale
+
+    @staticmethod
+    def _big_phi(x: jax.Array) -> jax.Array:
+        return 0.5 * (1 + jax.lax.erf(x / math.sqrt(2)))
+
+    @staticmethod
+    def _inv_big_phi(x: jax.Array) -> jax.Array:
+        return math.sqrt(2) * jax.lax.erf_inv(2 * x - 1)
+
+    @property
+    def _Z(self) -> jax.Array:
+        return jnp.clip(self._big_phi(self._beta) - self._big_phi(self._alpha), 1e-8, None)
+
+    @property
+    def mean(self) -> jax.Array:
+        phi = lambda x: jnp.exp(-0.5 * x**2) / math.sqrt(2 * math.pi)
+        return self.loc + self.scale * (phi(self._alpha) - phi(self._beta)) / self._Z
+
+    @property
+    def mode(self) -> jax.Array:
+        return jnp.clip(self.loc, self.a, self.b)
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        u = jax.random.uniform(key, self.loc.shape, dtype=self.loc.dtype, minval=1e-6, maxval=1 - 1e-6)
+        cdf_a = self._big_phi(self._alpha)
+        x = self._inv_big_phi(cdf_a + u * self._Z)
+        out = self.loc + self.scale * x
+        # keep gradients through loc/scale but clamp the value into the support
+        eps = 1e-6
+        return jnp.clip(out, self.a + eps, self.b - eps)
+
+    sample = rsample
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        z = (value - self.loc) / self.scale
+        lp = -0.5 * z**2 - 0.5 * math.log(2 * math.pi) - jnp.log(self.scale) - jnp.log(self._Z)
+        return _sum_last_dims(lp, self.event_dims)
+
+    def entropy(self) -> jax.Array:
+        # differential entropy of the truncated normal
+        phi = lambda x: jnp.exp(-0.5 * x**2) / math.sqrt(2 * math.pi)
+        Z = self._Z
+        term = (self._alpha * phi(self._alpha) - self._beta * phi(self._beta)) / (2 * Z)
+        ent = 0.5 * math.log(2 * math.pi * math.e) + jnp.log(self.scale * Z) + term
+        return _sum_last_dims(ent, self.event_dims)
+
+
+class Categorical:
+    """Categorical over the last axis of ``logits``."""
+
+    def __init__(self, logits: jax.Array):
+        self.logits = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def mode(self) -> jax.Array:
+        return jnp.argmax(self.logits, axis=-1)
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jax.random.categorical(key, self.logits, axis=-1)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        value = value.astype(jnp.int32)
+        return jnp.take_along_axis(self.logits, value[..., None], axis=-1)[..., 0]
+
+    def entropy(self) -> jax.Array:
+        p = self.probs
+        return -jnp.sum(p * self.logits, axis=-1)
+
+
+class OneHotCategorical:
+    """One-hot categorical, optionally with straight-through gradients
+    (reference distribution.py:281-406 ``OneHotCategorical[StraightThrough]ValidateArgs``).
+
+    ``event_dims`` follows torch's Independent: log_prob/entropy sum over that
+    many trailing *batch* dims after the categorical reduction.
+    """
+
+    def __init__(self, logits: jax.Array, event_dims: int = 0):
+        self.logits = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+        self.event_dims = event_dims
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def mode(self) -> jax.Array:
+        idx = jnp.argmax(self.logits, axis=-1)
+        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.logits.dtype)
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.probs
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        idx = jax.random.categorical(key, self.logits, axis=-1)
+        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.logits.dtype)
+
+    def rsample(self, key: jax.Array) -> jax.Array:
+        """Straight-through gradient sample: forward = hard one-hot,
+        backward = softmax probabilities (stop_gradient trick)."""
+        hard = self.sample(key)
+        probs = self.probs
+        return hard + probs - jax.lax.stop_gradient(probs)
+
+    def straight_through(self, hard: jax.Array) -> jax.Array:
+        probs = self.probs
+        return hard + probs - jax.lax.stop_gradient(probs)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        lp = jnp.sum(value * self.logits, axis=-1)
+        return _sum_last_dims(lp, self.event_dims)
+
+    def entropy(self) -> jax.Array:
+        p = self.probs
+        ent = -jnp.sum(p * self.logits, axis=-1)
+        return _sum_last_dims(ent, self.event_dims)
+
+
+def kl_categorical(p_logits: jax.Array, q_logits: jax.Array, event_dims: int = 0) -> jax.Array:
+    """KL(p || q) between categoricals over the last axis, summing ``event_dims``
+    trailing batch dims (torch ``kl_divergence(Independent(OneHotCat...)...)``,
+    used by DreamerV2/V3 KL balancing, reference algos/dreamer_v3/loss.py:70-83)."""
+    p_logits = p_logits - jax.nn.logsumexp(p_logits, axis=-1, keepdims=True)
+    q_logits = q_logits - jax.nn.logsumexp(q_logits, axis=-1, keepdims=True)
+    p = jax.nn.softmax(p_logits, axis=-1)
+    kl = jnp.sum(p * (p_logits - q_logits), axis=-1)
+    return _sum_last_dims(kl, event_dims)
+
+
+class Bernoulli:
+    """Bernoulli with a defined mode (reference ``BernoulliSafeMode``,
+    distribution.py:409-416)."""
+
+    def __init__(self, logits: jax.Array, event_dims: int = 0):
+        self.logits = logits
+        self.event_dims = event_dims
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.sigmoid(self.logits)
+
+    @property
+    def mode(self) -> jax.Array:
+        return (self.probs > 0.5).astype(self.logits.dtype)
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.probs
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jax.random.bernoulli(key, self.probs).astype(self.logits.dtype)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        # -softplus(-l) for value 1, -softplus(l) for value 0 (numerically stable BCE)
+        lp = -jax.nn.softplus(-self.logits) * value - jax.nn.softplus(self.logits) * (1 - value)
+        return _sum_last_dims(lp, self.event_dims)
+
+
+class SymlogDistribution:
+    """Symlog-MSE pseudo-distribution for vector reconstruction
+    (reference distribution.py:152-193)."""
+
+    def __init__(self, mode: jax.Array, dims: int, dist: str = "mse", agg: str = "sum", tol: float = 1e-8):
+        self._mode = mode
+        self._dims = dims
+        self._dist = dist
+        self._agg = agg
+        self._tol = tol
+
+    @property
+    def mode(self) -> jax.Array:
+        return symexp(self._mode)
+
+    @property
+    def mean(self) -> jax.Array:
+        return symexp(self._mode)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
+        if self._dist == "mse":
+            distance = (self._mode - symlog(value)) ** 2
+        elif self._dist == "abs":
+            distance = jnp.abs(self._mode - symlog(value))
+        else:
+            raise NotImplementedError(self._dist)
+        distance = jnp.where(distance < self._tol, 0.0, distance)
+        axes = tuple(range(-self._dims, 0))
+        loss = jnp.mean(distance, axis=axes) if self._agg == "mean" else jnp.sum(distance, axis=axes)
+        return -loss
+
+
+class MSEDistribution:
+    """Plain MSE pseudo-distribution (DV3 image decoder head,
+    reference distribution.py:196-221)."""
+
+    def __init__(self, mode: jax.Array, dims: int, agg: str = "sum"):
+        self._mode = mode
+        self._dims = dims
+        self._agg = agg
+
+    @property
+    def mode(self) -> jax.Array:
+        return self._mode
+
+    @property
+    def mean(self) -> jax.Array:
+        return self._mode
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
+        distance = (self._mode - value) ** 2
+        axes = tuple(range(-self._dims, 0))
+        loss = jnp.mean(distance, axis=axes) if self._agg == "mean" else jnp.sum(distance, axis=axes)
+        return -loss
+
+
+class TwoHotEncodingDistribution:
+    """255-bin two-hot symlog distribution over scalars (DV3 reward head and
+    critic, reference distribution.py:224-278)."""
+
+    def __init__(
+        self,
+        logits: jax.Array,
+        dims: int = 0,
+        low: int = -20,
+        high: int = 20,
+        transfwd: Callable[[jax.Array], jax.Array] = symlog,
+        transbwd: Callable[[jax.Array], jax.Array] = symexp,
+    ):
+        self.logits = logits
+        self.dims = dims
+        self.low = low
+        self.high = high
+        self.transfwd = transfwd
+        self.transbwd = transbwd
+        self.bins = jnp.linspace(low, high, logits.shape[-1], dtype=logits.dtype)
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def _reduce_axes(self) -> tuple:
+        # reference dims=(-1,) for dims=1: reduce the bins axis (which replaces
+        # the scalar (..., 1) event axis) plus any extra trailing event dims
+        return tuple(range(-max(self.dims, 1), 0))
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.transbwd(jnp.sum(self.probs * self.bins, axis=self._reduce_axes, keepdims=True))
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.mean
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        x = self.transfwd(x)
+        nbins = self.bins.shape[0]
+        below = jnp.sum((self.bins <= x).astype(jnp.int32), axis=-1, keepdims=True) - 1
+        above = below + 1
+        above = jnp.clip(above, 0, nbins - 1)
+        below = jnp.clip(below, 0, nbins - 1)
+        equal = below == above
+        dist_to_below = jnp.where(equal, 1.0, jnp.abs(self.bins[below] - x))
+        dist_to_above = jnp.where(equal, 1.0, jnp.abs(self.bins[above] - x))
+        total = dist_to_below + dist_to_above
+        weight_below = dist_to_above / total
+        weight_above = dist_to_below / total
+        target = (
+            jax.nn.one_hot(below, nbins, dtype=self.logits.dtype) * weight_below[..., None]
+            + jax.nn.one_hot(above, nbins, dtype=self.logits.dtype) * weight_above[..., None]
+        )[..., 0, :]
+        log_pred = self.logits - jax.nn.logsumexp(self.logits, axis=-1, keepdims=True)
+        return jnp.sum(target * log_pred, axis=self._reduce_axes)
+
+
+class MultiCategorical:
+    """Product of independent categoricals (MultiDiscrete action spaces)."""
+
+    def __init__(self, logits_list):
+        self.dists = [OneHotCategorical(lg) for lg in logits_list]
+
+    def sample(self, key: jax.Array):
+        keys = jax.random.split(key, len(self.dists))
+        return [d.sample(k) for d, k in zip(self.dists, keys)]
+
+    def log_prob(self, values) -> jax.Array:
+        return sum(d.log_prob(v) for d, v in zip(self.dists, values))
+
+    def entropy(self) -> jax.Array:
+        return sum(d.entropy() for d in self.dists)

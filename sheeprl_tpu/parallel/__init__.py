@@ -1,0 +1,4 @@
+from sheeprl_tpu.parallel.runtime import Runtime, get_single_device_runtime
+from sheeprl_tpu.parallel.mesh import make_mesh, replicate, shard_along
+
+__all__ = ["Runtime", "get_single_device_runtime", "make_mesh", "replicate", "shard_along"]

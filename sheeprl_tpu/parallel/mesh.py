@@ -1,0 +1,61 @@
+"""Device-mesh helpers.
+
+The reference scales with Lightning Fabric DDP (one process per device, NCCL
+all-reduce — see SURVEY §2.4).  The TPU-native design is single-controller:
+one process drives all local chips through a `jax.sharding.Mesh`; gradient
+reduction is whatever XLA inserts for a batch-sharded / param-replicated jit —
+a `psum` riding ICI.  Multi-host extends the same mesh over DCN via
+`jax.distributed.initialize` without changing any algorithm code.
+
+Axis conventions used across the framework:
+- ``data``: data-parallel axis (batch sharded, params replicated)
+- ``trainer``/player sub-meshes: decoupled topology (parallel/decoupled.py)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = ("data",),
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    arr = np.asarray(devices)
+    if len(axis_names) > 1:
+        raise NotImplementedError("only 1-D meshes are used in this build")
+    return Mesh(arr.reshape(-1), axis_names)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Fully replicate a pytree over the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def shard_along(tree: Any, mesh: Mesh, axis_name: str = "data", axis: int = 0) -> Any:
+    """Shard every leaf's ``axis`` dimension over ``axis_name``."""
+
+    def put(x):
+        spec = [None] * np.ndim(x)
+        spec[axis] = axis_name
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,187 @@
+"""The `Runtime`: TPU-native replacement for Lightning Fabric.
+
+The reference instantiates `lightning.fabric.Fabric` from config and calls
+`fabric.launch(entrypoint, cfg)` — the single process-spawn point
+(/root/reference/sheeprl/cli.py:101-199).  On TPU there is nothing to spawn:
+JAX is single-controller per host, every local chip is already visible, and
+multi-host synchronization comes from `jax.distributed`.  `Runtime` therefore
+carries:
+
+- the device mesh (1-D ``data`` axis) and precision policy;
+- PRNG seeding;
+- host-side "collectives" that mirror Fabric's API surface
+  (`all_gather`/`broadcast`/object broadcast) — trivial in-process when
+  world_size==1 per host, `multihost_utils` when distributed;
+- the callback hook mechanism (`runtime.call("on_checkpoint_coupled", ...)`)
+  used by the checkpoint callback (reference utils/callback.py:14-148).
+
+A second, strategy-free runtime for "player" models
+(`get_single_device_runtime`, reference utils/fabric.py:8-35) is a
+device-pinning helper here: players run on ``mesh.devices[0]`` and never touch
+collectives.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.parallel.mesh import make_mesh
+
+_PRECISION_TO_DTYPES = {
+    # precision -> (param_dtype, compute_dtype)
+    "32-true": (jnp.float32, jnp.float32),
+    "16-mixed": (jnp.float32, jnp.bfloat16),  # fp16 has no TPU advantage; bf16 is native
+    "bf16-mixed": (jnp.float32, jnp.bfloat16),
+    "bf16-true": (jnp.bfloat16, jnp.bfloat16),
+    "64-true": (jnp.float64, jnp.float64),
+}
+
+
+class Runtime:
+    def __init__(
+        self,
+        devices: int | str = 1,
+        num_nodes: int = 1,
+        strategy: str = "auto",
+        accelerator: str = "auto",
+        precision: str = "32-true",
+        callbacks: Optional[Sequence[Any]] = None,
+    ):
+        self.num_nodes = num_nodes
+        self.strategy = strategy
+        self.accelerator = accelerator
+        self.precision = precision
+        if precision not in _PRECISION_TO_DTYPES:
+            raise ValueError(f"Unknown precision '{precision}'; valid: {list(_PRECISION_TO_DTYPES)}")
+        self.param_dtype, self.compute_dtype = _PRECISION_TO_DTYPES[precision]
+        self.callbacks = list(callbacks or [])
+
+        # Multi-host: initialize jax.distributed only when a coordinator is set
+        # (TPU pods set these in the environment). Single host: no-op.
+        if num_nodes > 1 and not jax.process_count() > 1 and os.environ.get("JAX_COORDINATOR_ADDRESS"):
+            jax.distributed.initialize()  # pragma: no cover - needs a pod
+
+        available = jax.devices()
+        if devices in ("auto", -1, "-1"):
+            n = len(available)
+        else:
+            n = int(devices)
+        if n > len(available):
+            raise ValueError(f"Requested {n} devices but only {len(available)} are available")
+        self.mesh = make_mesh(n_devices=n, axis_names=("data",))
+        self._launched = False
+
+    # -- topology ---------------------------------------------------------
+    @property
+    def devices(self) -> List[Any]:
+        return list(self.mesh.devices.reshape(-1))
+
+    @property
+    def device(self) -> Any:
+        """The 'player' device (first in the mesh)."""
+        return self.devices[0]
+
+    @property
+    def world_size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def global_rank(self) -> int:
+        # single-controller: the process rank; per-device rank only matters
+        # inside jitted collectives which use mesh axes instead.
+        return jax.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return jax.process_index() == 0
+
+    # -- launch -----------------------------------------------------------
+    def launch(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        """Run the entrypoint. No process spawn: the mesh already spans all
+        local devices (ICI) and, when `jax.distributed` is initialized, all
+        hosts (DCN)."""
+        self._launched = True
+        return fn(self, *args, **kwargs)
+
+    # -- precision --------------------------------------------------------
+    def cast(self, tree: Any) -> Any:
+        """Cast floating leaves to the compute dtype."""
+        def _cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(self.compute_dtype)
+            return x
+
+        return jax.tree_util.tree_map(_cast, tree)
+
+    # -- host collectives (Fabric API surface) -----------------------------
+    def all_gather(self, tree: Any) -> Any:
+        """Gather across *processes* (multi-host). In-process device-sharded
+        values are already globally addressable, so this is the identity on a
+        single host."""
+        if jax.process_count() == 1:
+            return tree
+        from jax.experimental import multihost_utils  # pragma: no cover
+
+        return multihost_utils.process_allgather(tree)  # pragma: no cover
+
+    def broadcast(self, obj: Any, src: int = 0) -> Any:
+        if jax.process_count() == 1:
+            return obj
+        from jax.experimental import multihost_utils  # pragma: no cover
+
+        return multihost_utils.broadcast_one_to_all(obj)  # pragma: no cover
+
+    def barrier(self) -> None:
+        if jax.process_count() > 1:  # pragma: no cover
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("sheeprl_tpu_barrier")
+
+    # -- callbacks ---------------------------------------------------------
+    def call(self, hook_name: str, **kwargs: Any) -> None:
+        for cb in self.callbacks:
+            hook = getattr(cb, hook_name, None)
+            if hook is not None:
+                hook(runtime=self, **kwargs)
+
+    # -- checkpoint io ------------------------------------------------------
+    def save(self, path: str, state: Dict[str, Any]) -> None:
+        from sheeprl_tpu.utils.checkpoint import save_state
+
+        if self.is_global_zero:
+            save_state(path, state)
+        self.barrier()
+
+    def load(self, path: str) -> Dict[str, Any]:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        return load_state(path)
+
+    def seed_everything(self, seed: int) -> jax.Array:
+        np.random.seed(seed)
+        import random
+
+        random.seed(seed)
+        return jax.random.PRNGKey(seed)
+
+
+def get_single_device_runtime(runtime: Runtime) -> Runtime:
+    """Strategy-free runtime sharing device/precision with `runtime`
+    (reference utils/fabric.py:8-35): used to wrap player models so env
+    interaction never crosses collectives."""
+    single = Runtime.__new__(Runtime)
+    single.num_nodes = 1
+    single.strategy = "single"
+    single.accelerator = runtime.accelerator
+    single.precision = runtime.precision
+    single.param_dtype = runtime.param_dtype
+    single.compute_dtype = runtime.compute_dtype
+    single.callbacks = runtime.callbacks
+    single.mesh = make_mesh(n_devices=1, devices=[runtime.device])
+    single._launched = True
+    return single

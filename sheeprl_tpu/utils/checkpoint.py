@@ -1,0 +1,113 @@
+"""Checkpoint save/load + the checkpoint callback.
+
+Replaces the reference's `fabric.save`/`fabric.load` + `CheckpointCallback`
+(/root/reference/sheeprl/utils/callback.py:14-148).  State is a nested dict of
+param/optimizer pytrees (numpy-ified before serialization), host counters and
+small python objects; buffers are optionally included.  The reference's
+"gather buffers from all ranks over Gloo" collapses in the single-controller
+design: all env buffers already live in this process.  The truncated-flag
+surgery (callback.py:91-143) is preserved so resumed buffers bootstrap
+correctly.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from pathlib import Path
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from sheeprl_tpu.utils.utils import npify
+
+
+def save_state(path: str, state: Dict[str, Any]) -> None:
+    path = str(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fp:
+        pickle.dump(npify(state), fp, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def load_state(path: str) -> Dict[str, Any]:
+    with open(path, "rb") as fp:
+        return pickle.load(fp)
+
+
+class CheckpointCallback:
+    """Checkpoint orchestration hook (reference utils/callback.py:14-148).
+
+    Invoked via ``runtime.call("on_checkpoint_coupled", ...)`` etc.  If a
+    buffer is passed and ``buffer.checkpoint`` is enabled, its content is
+    snapshotted with the truncation-consistency fix: the last stored step of
+    every in-flight episode is marked truncated so bootstrapping on resume
+    does not leak across the checkpoint boundary.
+    """
+
+    def __init__(self, keep_last: Optional[int] = None):
+        self.keep_last = keep_last
+
+    def on_checkpoint_coupled(
+        self,
+        runtime,
+        ckpt_path: str,
+        state: Dict[str, Any],
+        replay_buffer: Any = None,
+    ) -> None:
+        if replay_buffer is not None:
+            rb_state = self._ckpt_rb(replay_buffer)
+            state = {**state, "rb": rb_state}
+        runtime.save(ckpt_path, state)
+        if replay_buffer is not None:
+            self._experiment_consistent_rb(replay_buffer)
+        if self.keep_last:
+            self._delete_old_checkpoints(Path(ckpt_path).parent)
+
+    # player/trainer variants share the same single-controller path
+    on_checkpoint_player = on_checkpoint_coupled
+    on_checkpoint_trainer = on_checkpoint_coupled
+
+    def _ckpt_rb(self, rb) -> Any:
+        """Mark the last inserted step truncated before snapshotting
+        (reference callback.py:91-123). Returns serializable buffer state."""
+        from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, ReplayBuffer
+
+        if isinstance(rb, ReplayBuffer):
+            if "truncated" in rb.buffer and not rb.empty:
+                self._saved_trunc = rb["truncated"][(rb._pos - 1) % rb.buffer_size, :].copy()
+                rb["truncated"][(rb._pos - 1) % rb.buffer_size, :] = True
+        elif isinstance(rb, EnvIndependentReplayBuffer):
+            self._saved_trunc = []
+            for b in rb.buffer:
+                if "truncated" in b.buffer and not b.empty:
+                    self._saved_trunc.append(b["truncated"][(b._pos - 1) % b.buffer_size, :].copy())
+                    b["truncated"][(b._pos - 1) % b.buffer_size, :] = True
+                else:
+                    self._saved_trunc.append(None)
+        elif isinstance(rb, EpisodeBuffer):
+            pass  # episodes are stored whole; open episodes are dropped on save
+        return rb.state_dict() if hasattr(rb, "state_dict") else rb
+
+    def _experiment_consistent_rb(self, rb) -> None:
+        """Undo the truncation surgery after saving (reference callback.py:125-143)."""
+        from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, ReplayBuffer
+
+        saved = getattr(self, "_saved_trunc", None)
+        if saved is None:
+            return
+        if isinstance(rb, ReplayBuffer):
+            if "truncated" in rb.buffer and not rb.empty:
+                rb["truncated"][(rb._pos - 1) % rb.buffer_size, :] = saved
+        elif isinstance(rb, EnvIndependentReplayBuffer):
+            for b, s in zip(rb.buffer, saved):
+                if s is not None:
+                    b["truncated"][(b._pos - 1) % b.buffer_size, :] = s
+        self._saved_trunc = None
+
+    def _delete_old_checkpoints(self, ckpt_folder: Path) -> None:
+        """`keep_last` pruning (reference callback.py:145-148)."""
+        ckpts = sorted(ckpt_folder.glob("*.ckpt"), key=os.path.getmtime)
+        for old in ckpts[: -self.keep_last]:
+            old.unlink(missing_ok=True)

@@ -1,0 +1,26 @@
+"""Optional-dependency gates (reference: /root/reference/sheeprl/utils/imports.py:1-17)."""
+
+from __future__ import annotations
+
+import importlib.util
+
+
+def _available(module: str) -> bool:
+    try:
+        return importlib.util.find_spec(module) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+_IS_WANDB_AVAILABLE = _available("wandb")
+_IS_MLFLOW_AVAILABLE = _available("mlflow")
+_IS_OPTUNA_AVAILABLE = _available("optuna")
+_IS_ATARI_AVAILABLE = _available("ale_py")
+_IS_DMC_AVAILABLE = _available("dm_control")
+_IS_CRAFTER_AVAILABLE = _available("crafter")
+_IS_DIAMBRA_AVAILABLE = _available("diambra")
+_IS_MINEDOJO_AVAILABLE = _available("minedojo")
+_IS_MINERL_AVAILABLE = _available("minerl")
+_IS_SUPER_MARIO_AVAILABLE = _available("gym_super_mario_bros")
+_IS_TORCH_AVAILABLE = _available("torch")
+_IS_TENSORBOARD_AVAILABLE = _available("tensorboard") or _IS_TORCH_AVAILABLE

@@ -1,0 +1,75 @@
+"""Algorithm / evaluation registries.
+
+Mirrors the decorator-registration design of the reference
+(/root/reference/sheeprl/utils/registry.py:11-108): algorithms register
+themselves at import time; the CLI looks the entrypoint up by name.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+# module path -> list of {name, entrypoint, decoupled}
+algorithm_registry: Dict[str, List[Dict[str, Any]]] = {}
+# module path -> list of {name, entrypoint}
+evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
+
+
+def _register_algorithm(fn: Callable, decoupled: bool = False) -> Callable:
+    module = fn.__module__
+    entrypoint = fn.__name__
+    algo_name = module.split(".")[-1]
+    metadata = {"name": algo_name, "entrypoint": entrypoint, "decoupled": decoupled}
+    registered = algorithm_registry.setdefault(module, [])
+    if any(m["name"] == algo_name and m["entrypoint"] == entrypoint for m in registered):
+        raise ValueError(f"Algorithm '{algo_name}' already registered from module '{module}'")
+    registered.append(metadata)
+    return fn
+
+
+def _register_evaluation(fn: Callable, algorithms: str | List[str]) -> Callable:
+    module = fn.__module__
+    entrypoint = fn.__name__
+    if isinstance(algorithms, str):
+        algorithms = [algorithms]
+    registered = evaluation_registry.setdefault(module, [])
+    for algo in algorithms:
+        registered.append({"name": algo, "entrypoint": entrypoint})
+    return fn
+
+
+def register_algorithm(decoupled: bool = False) -> Callable:
+    def inner(fn: Callable) -> Callable:
+        return _register_algorithm(fn, decoupled=decoupled)
+
+    return inner
+
+
+def register_evaluation(algorithms: str | List[str]) -> Callable:
+    def inner(fn: Callable) -> Callable:
+        return _register_evaluation(fn, algorithms=algorithms)
+
+    return inner
+
+
+def find_algorithm(name: str) -> Optional[Dict[str, Any]]:
+    """Return {module, name, entrypoint, decoupled} for a registered algorithm."""
+    for module, entries in algorithm_registry.items():
+        for meta in entries:
+            if meta["name"] == name:
+                return {"module": module, **meta}
+    return None
+
+
+def find_evaluation(name: str) -> Optional[Dict[str, Any]]:
+    for module, entries in evaluation_registry.items():
+        for meta in entries:
+            if meta["name"] == name:
+                return {"module": module, **meta}
+    return None
+
+
+def tasks() -> Dict[str, List[str]]:
+    """All registered algorithm names grouped by module (for the agents table)."""
+    return {module: [m["name"] for m in entries] for module, entries in algorithm_registry.items()}

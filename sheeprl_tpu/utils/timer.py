@@ -1,0 +1,48 @@
+"""Wall-clock timers (reference /root/reference/sheeprl/utils/timer.py:16-106).
+
+A `ContextDecorator` with a class-level registry of named `SumMetric`s; the
+train loops time their two hot phases (`Time/env_interaction_time`,
+`Time/train_time`) and derive SPS metrics from them.  A global `disabled`
+kill-switch mirrors `cfg.metric.disable_timer`.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Dict, Optional
+
+from sheeprl_tpu.utils.metric import SumMetric
+
+
+class timer(ContextDecorator):
+    disabled: bool = False
+    timers: Dict[str, SumMetric] = {}
+
+    def __init__(self, name: str, metric: Optional[SumMetric] = None):
+        self.name = name
+        if not timer.disabled and name not in timer.timers:
+            timer.timers[name] = metric if metric is not None else SumMetric()
+
+    def __enter__(self) -> "timer":
+        if not timer.disabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if not timer.disabled:
+            timer.timers[self.name].update(time.perf_counter() - self._start)
+        return False
+
+    @classmethod
+    def to(cls, device) -> None:
+        pass  # host-side
+
+    @classmethod
+    def compute(cls) -> Dict[str, float]:
+        return {name: m.compute() for name, m in cls.timers.items()}
+
+    @classmethod
+    def reset(cls) -> None:
+        for m in cls.timers.values():
+            m.reset()

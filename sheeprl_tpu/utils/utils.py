@@ -1,0 +1,163 @@
+"""Core host-side utilities.
+
+TPU-native re-design of the reference's ``sheeprl/utils/utils.py`` (see
+/root/reference/sheeprl/utils/utils.py:34-316).  Device-side numerics (symlog,
+two-hot, GAE, lambda-values) live in :mod:`sheeprl_tpu.ops` as pure JAX
+functions; this module keeps only what genuinely belongs on the host:
+config containers, schedules and the `Ratio` replay-ratio scheduler.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, Mapping, Sequence
+
+import numpy as np
+
+
+class dotdict(dict):
+    """A dictionary supporting dot notation (reference: utils/utils.py:34-60)."""
+
+    __getattr__ = dict.get
+    __setattr__ = dict.__setitem__
+    __delattr__ = dict.__delitem__
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for k, v in self.items():
+            if isinstance(v, dict) and not isinstance(v, dotdict):
+                self[k] = dotdict(v)
+
+    def __getstate__(self):
+        return dict(self)
+
+    def __setstate__(self, state):
+        self.update(state)
+
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in self.items():
+            out[k] = v.as_dict() if isinstance(v, dotdict) else v
+        return out
+
+
+def polynomial_decay(
+    current_step: int,
+    *,
+    initial: float = 1.0,
+    final: float = 0.0,
+    max_decay_steps: int = 100,
+    power: float = 1.0,
+) -> float:
+    """Polynomially decay a coefficient (reference: utils/utils.py:128-145)."""
+    if current_step > max_decay_steps or initial == final:
+        return final
+    return (initial - final) * ((1 - current_step / max_decay_steps) ** power) + final
+
+
+class Ratio:
+    """Replay-ratio scheduler: how many gradient steps to run per new policy
+    steps (reference: utils/utils.py:262-300, itself after Hafner's DreamerV3).
+
+    Stateful on purpose: it lives on the host next to the training loop and is
+    checkpointed via ``state_dict``.
+    """
+
+    def __init__(self, ratio: float, pretrain_steps: int = 0):
+        if pretrain_steps < 0:
+            raise ValueError(f"'pretrain_steps' must be non-negative, got {pretrain_steps}")
+        if ratio < 0:
+            raise ValueError(f"'ratio' must be non-negative, got {ratio}")
+        self._pretrain_steps = pretrain_steps
+        self._ratio = ratio
+        self._prev: float | None = None
+
+    def __call__(self, step: int) -> int:
+        if self._ratio == 0:
+            return 0
+        if self._prev is None:
+            self._prev = step
+            repeats = int(step * self._ratio)
+            if self._pretrain_steps > 0:
+                if step < self._pretrain_steps:
+                    warnings.warn(
+                        "The number of pretrain steps is greater than the number of current steps. "
+                        f"This could lead to a higher ratio than the one specified ({self._ratio}). "
+                        "Setting the 'pretrain_steps' equal to the number of current steps."
+                    )
+                    self._pretrain_steps = step
+                repeats = int(self._pretrain_steps * self._ratio)
+            return repeats
+        repeats = int((step - self._prev) * self._ratio)
+        self._prev += repeats / self._ratio
+        return repeats
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"_ratio": self._ratio, "_prev": self._prev, "_pretrain_steps": self._pretrain_steps}
+
+    def load_state_dict(self, state_dict: Mapping[str, Any]) -> "Ratio":
+        self._ratio = state_dict["_ratio"]
+        self._prev = state_dict["_prev"]
+        self._pretrain_steps = state_dict["_pretrain_steps"]
+        return self
+
+
+def print_config(
+    cfg: Mapping[str, Any],
+    fields: Sequence[str] = ("algo", "buffer", "checkpoint", "env", "fabric", "metric"),
+) -> None:
+    """Pretty-print the composed config tree (reference: utils/utils.py:210-246)."""
+    try:
+        import rich.syntax
+        import rich.tree
+        import yaml
+
+        tree = rich.tree.Tree("CONFIG", style="dim", guide_style="dim")
+        for field in fields:
+            section = cfg.get(field)
+            if section is None:
+                continue
+            branch = tree.add(field, style="dim", guide_style="dim")
+            if isinstance(section, dict):
+                content = yaml.safe_dump(section.as_dict() if isinstance(section, dotdict) else dict(section))
+            else:
+                content = str(section)
+            branch.add(rich.syntax.Syntax(content, "yaml"))
+        rich.print(tree)
+    except Exception:  # pragma: no cover - cosmetic only
+        pass
+
+
+def save_configs(cfg: "dotdict", log_dir: str) -> None:
+    """Archive the run config as YAML (reference: utils/utils.py:249-251)."""
+    import yaml
+
+    os.makedirs(log_dir, exist_ok=True)
+    with open(os.path.join(log_dir, "config.yaml"), "w") as fp:
+        yaml.safe_dump(cfg.as_dict() if isinstance(cfg, dotdict) else dict(cfg), fp, sort_keys=False)
+
+
+def nest_dotted(flat: Mapping[str, Any]) -> Dict[str, Any]:
+    """Turn ``{"a.b": 1}`` into ``{"a": {"b": 1}}``."""
+    out: Dict[str, Any] = {}
+    for key, value in flat.items():
+        node = out
+        parts = key.split(".")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return out
+
+
+def unbind_parameters(tree):
+    """No-op placeholder mirroring the reference's ``unwrap_fabric``: parameters
+    in JAX are plain pytrees of arrays, there is nothing to unwrap."""
+    return tree
+
+
+def npify(tree):
+    """Convert a pytree of (possibly device) arrays to host numpy arrays."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

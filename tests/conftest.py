@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Multi-device simulation: the reference simulates multi-node with 2-process
+Gloo DDP on CPU (reference tests/test_algos/test_algos.py:16-53); the JAX
+equivalent is a virtual 8-device CPU platform via
+``--xla_force_host_platform_device_count`` (SURVEY §4), set *before* jax
+initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _tmp_logs(tmp_path, monkeypatch):
+    """Keep run artifacts (logs/, checkpoints) inside pytest tmp dirs."""
+    monkeypatch.chdir(tmp_path)
+    yield

@@ -1,0 +1,80 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models import CNN, DeCNN, LayerNormGRUCell, MLP, MultiDecoder, MultiEncoder, NatureCNN
+from sheeprl_tpu.models.blocks import cnn_forward
+
+
+def test_mlp_shapes_and_layernorm():
+    m = MLP(hidden_sizes=(32, 32), output_dim=4, activation="silu", layer_norm=True)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((2, 8)))
+    y = m.apply(params, jnp.ones((2, 8)))
+    assert y.shape == (2, 4)
+
+
+def test_mlp_no_output_head():
+    m = MLP(hidden_sizes=(16,))
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((3, 5)))
+    assert m.apply(params, jnp.ones((3, 5))).shape == (3, 16)
+
+
+def test_cnn_and_cnn_forward_leading_dims():
+    m = CNN(channels=(8, 16), kernel_sizes=(3, 3), strides=(2, 2), layer_norm=True)
+    x = jnp.zeros((2, 4, 3, 16, 16))  # [T, B, C, H, W] convention from buffers
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+    y = cnn_forward(lambda z: m.apply(params, z), x)
+    assert y.shape[:2] == (2, 4) and y.ndim == 3
+
+
+def test_decnn_upsamples():
+    m = DeCNN(channels=(16, 3), kernel_sizes=(4, 4), strides=(2, 2))
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 32)))
+    y = m.apply(params, jnp.zeros((5, 8, 8, 32)))
+    assert y.shape == (5, 32, 32, 3)
+
+
+def test_nature_cnn():
+    m = NatureCNN(features_dim=512)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 4)))
+    y = m.apply(params, jnp.zeros((7, 64, 64, 4)))
+    assert y.shape == (7, 512)
+
+
+def test_layernorm_gru_cell_step_and_scan():
+    cell = LayerNormGRUCell(hidden_size=16)
+    h0 = jnp.zeros((3, 16))
+    x = jnp.ones((3, 8))
+    params = cell.init(jax.random.PRNGKey(0), h0, x)
+    h1 = cell.apply(params, h0, x)
+    assert h1.shape == (3, 16)
+    assert np.abs(np.asarray(h1)).sum() > 0
+
+    # scan over time must equal the step-by-step loop
+    xs = jnp.broadcast_to(x, (5, 3, 8))
+
+    def step(h, xt):
+        hn = cell.apply(params, h, xt)
+        return hn, hn
+
+    _, hs_scan = jax.lax.scan(step, h0, xs)
+    h = h0
+    for t in range(5):
+        h = cell.apply(params, h, xs[t])
+    np.testing.assert_allclose(np.asarray(hs_scan[-1]), np.asarray(h), rtol=1e-5, atol=1e-6)
+
+
+def test_multi_encoder_decoder():
+    cnn = CNN(channels=(8,), kernel_sizes=(3,), strides=(2,))
+    mlp = MLP(hidden_sizes=(16,))
+    enc = MultiEncoder(cnn_encoder=cnn, mlp_encoder=mlp, cnn_keys=("rgb",), mlp_keys=("state",))
+    obs = {"rgb": jnp.zeros((2, 3, 8, 8)), "state": jnp.zeros((2, 4))}
+    params = enc.init(jax.random.PRNGKey(0), obs)
+    y = enc.apply(params, obs)
+    assert y.ndim == 2 and y.shape[0] == 2
+
+    mlp_dec = MLP(hidden_sizes=(8,), output_dim=6)
+    dec = MultiDecoder(cnn_decoder=None, mlp_decoder=mlp_dec, mlp_keys=("a", "b"), mlp_dims=(2, 4))
+    dparams = dec.init(jax.random.PRNGKey(0), jnp.zeros((2, 16)))
+    out = dec.apply(dparams, jnp.zeros((2, 16)))
+    assert out["a"].shape == (2, 2) and out["b"].shape == (2, 4)

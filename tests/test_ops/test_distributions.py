@@ -1,0 +1,129 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.ops.distributions import (
+    Bernoulli,
+    Categorical,
+    MSEDistribution,
+    Normal,
+    OneHotCategorical,
+    SymlogDistribution,
+    TanhNormal,
+    TruncatedNormal,
+    TwoHotEncodingDistribution,
+    kl_categorical,
+)
+from sheeprl_tpu.ops.numerics import symexp
+
+
+def test_normal_log_prob_matches_scipy():
+    from scipy import stats
+
+    loc, scale = 0.3, 1.7
+    d = Normal(jnp.full((5,), loc), jnp.full((5,), scale))
+    x = jnp.linspace(-2, 2, 5)
+    np.testing.assert_allclose(np.asarray(d.log_prob(x)), stats.norm.logpdf(np.asarray(x), loc, scale), rtol=1e-4)
+
+
+def test_onehot_categorical_sample_and_st_grad():
+    logits = jnp.array([[2.0, 0.0, -2.0]])
+    d = OneHotCategorical(logits)
+    s = d.sample(jax.random.PRNGKey(0))
+    assert s.shape == (1, 3) and np.asarray(s.sum()) == 1.0
+
+    def f(lg):
+        dd = OneHotCategorical(lg)
+        y = dd.rsample(jax.random.PRNGKey(0))
+        return jnp.sum(y * jnp.arange(3.0))
+
+    g = jax.grad(f)(logits)
+    assert np.abs(np.asarray(g)).sum() > 0  # straight-through gradients flow
+
+
+def test_onehot_mode_logprob_entropy():
+    logits = jnp.log(jnp.array([[0.7, 0.2, 0.1]]))
+    d = OneHotCategorical(logits)
+    np.testing.assert_allclose(np.asarray(d.mode), [[1, 0, 0]])
+    np.testing.assert_allclose(np.asarray(d.log_prob(d.mode)), [np.log(0.7)], rtol=1e-3)
+    expected_ent = -(0.7 * np.log(0.7) + 0.2 * np.log(0.2) + 0.1 * np.log(0.1))
+    np.testing.assert_allclose(np.asarray(d.entropy()), [expected_ent], rtol=1e-3)
+
+
+def test_kl_categorical():
+    p = jnp.array([[1.0, 0.0, -1.0]])
+    np.testing.assert_allclose(np.asarray(kl_categorical(p, p)), [0.0], atol=1e-6)
+    q = jnp.array([[0.0, 1.0, 0.0]])
+    assert float(kl_categorical(p, q)[0]) > 0
+    # event dims sum: shape (B, E, K) -> (B,)
+    p3 = jnp.stack([p, p], axis=1)
+    assert kl_categorical(p3, p3, event_dims=1).shape == (1,)
+
+
+def test_bernoulli():
+    logits = jnp.array([0.0, 5.0, -5.0])
+    d = Bernoulli(logits)
+    np.testing.assert_allclose(np.asarray(d.mode), [0.0, 1.0, 0.0])
+    lp1 = np.asarray(d.log_prob(jnp.ones(3)))
+    np.testing.assert_allclose(lp1, np.log([0.5, 1 / (1 + np.exp(-5)), 1 / (1 + np.exp(5))]), rtol=1e-4)
+
+
+def test_tanh_normal_in_bounds_and_logprob():
+    d = TanhNormal(jnp.zeros((4, 2)), jnp.ones((4, 2)))
+    y, lp = d.rsample_and_log_prob(jax.random.PRNGKey(1))
+    assert np.all(np.abs(np.asarray(y)) < 1.0)
+    assert lp.shape == (4,)
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(d.log_prob(y)), rtol=1e-3, atol=1e-3)
+
+
+def test_truncated_normal_support():
+    d = TruncatedNormal(jnp.zeros((100, 1)), jnp.ones((100, 1)) * 2.0, a=-1.0, b=1.0)
+    s = d.rsample(jax.random.PRNGKey(2))
+    assert np.all(np.abs(np.asarray(s)) <= 1.0)
+    assert np.all(np.isfinite(np.asarray(d.log_prob(s))))
+
+
+def test_symlog_distribution():
+    mode = jnp.array([[1.0, 2.0]])
+    d = SymlogDistribution(mode, dims=1)
+    np.testing.assert_allclose(np.asarray(d.mean), np.asarray(symexp(mode)), rtol=1e-4)
+    # log_prob of the (symexp'd) mode is 0 (tolerance-clipped mse)
+    np.testing.assert_allclose(np.asarray(d.log_prob(symexp(mode))), [0.0], atol=1e-5)
+
+
+def test_mse_distribution():
+    mode = jnp.ones((2, 3, 4, 4))
+    d = MSEDistribution(mode, dims=3)
+    lp = d.log_prob(jnp.zeros_like(mode))
+    np.testing.assert_allclose(np.asarray(lp), [-48.0, -48.0], rtol=1e-4)
+
+
+def test_two_hot_distribution_mean_and_logprob():
+    # peaked logits on one bin -> mean == symexp(bin)
+    nbins = 255
+    logits = jnp.full((1, nbins), -1e9)
+    center = nbins // 2  # bin value 0.0 on [-20, 20]
+    logits = logits.at[0, center].set(0.0)
+    d = TwoHotEncodingDistribution(logits, dims=1)
+    np.testing.assert_allclose(np.asarray(d.mean), [[0.0]], atol=1e-4)
+    assert d.log_prob(jnp.array([[0.0]])).shape == (1,)
+    # log_prob maximized at the bin center
+    lp_center = float(d.log_prob(jnp.array([[0.0]]))[0])
+    lp_off = float(d.log_prob(jnp.array([[5.0]]))[0])
+    assert lp_center > lp_off
+
+
+def test_two_hot_distribution_grad_flows():
+    def loss(logits):
+        d = TwoHotEncodingDistribution(logits, dims=1)
+        return -jnp.sum(d.log_prob(jnp.full((4, 1), 2.5)))
+
+    g = jax.grad(loss)(jnp.zeros((4, 255)))
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_categorical():
+    logits = jnp.log(jnp.array([[0.5, 0.25, 0.25]]))
+    d = Categorical(logits)
+    np.testing.assert_allclose(np.asarray(d.log_prob(jnp.array([0]))), [np.log(0.5)], rtol=1e-4)
+    assert int(d.mode[0]) == 0

@@ -1,0 +1,122 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.ops import (
+    compute_lambda_values,
+    gae,
+    symexp,
+    symlog,
+    two_hot_decoder,
+    two_hot_encoder,
+    uniform_mix,
+)
+
+
+def test_symlog_symexp_roundtrip():
+    x = jnp.array([-100.0, -1.0, -0.1, 0.0, 0.1, 1.0, 100.0])
+    np.testing.assert_allclose(symexp(symlog(x)), x, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(symlog(jnp.array([np.e - 1])), jnp.array([1.0]), rtol=1e-4)
+
+
+def test_two_hot_encoder_simple():
+    # support [-2, 2] with 5 buckets [-2,-1,0,1,2]: x=0.5 -> 0.5 @ idx2, 0.5 @ idx3
+    x = jnp.array([[0.5]])
+    enc = two_hot_encoder(x, support_range=2, num_buckets=5)
+    np.testing.assert_allclose(np.asarray(enc), [[0.0, 0.0, 0.5, 0.5, 0.0]], atol=1e-6)
+
+
+def test_two_hot_encoder_on_bucket():
+    x = jnp.array([[1.0]])
+    enc = two_hot_encoder(x, support_range=2, num_buckets=5)
+    np.testing.assert_allclose(np.asarray(enc), [[0.0, 0.0, 0.0, 1.0, 0.0]], atol=1e-6)
+
+
+def test_two_hot_encoder_clipping():
+    enc = two_hot_encoder(jnp.array([[99.0]]), support_range=2, num_buckets=5)
+    np.testing.assert_allclose(np.asarray(enc), [[0.0, 0.0, 0.0, 0.0, 1.0]], atol=1e-6)
+    enc = two_hot_encoder(jnp.array([[-99.0]]), support_range=2, num_buckets=5)
+    np.testing.assert_allclose(np.asarray(enc), [[1.0, 0.0, 0.0, 0.0, 0.0]], atol=1e-6)
+
+
+@pytest.mark.parametrize("support_range,num_buckets", [(300, None), (20, 255), (10, 21)])
+def test_two_hot_roundtrip(support_range, num_buckets):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(-support_range, support_range, size=(64, 1)).astype(np.float32))
+    enc = two_hot_encoder(x, support_range, num_buckets)
+    assert np.allclose(np.asarray(enc.sum(-1)), 1.0, atol=1e-5)
+    dec = two_hot_decoder(enc, support_range)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(x), rtol=1e-4, atol=1e-3)
+
+
+def test_even_buckets_raises():
+    with pytest.raises(ValueError):
+        two_hot_encoder(jnp.zeros((1, 1)), support_range=2, num_buckets=4)
+    with pytest.raises(ValueError):
+        two_hot_decoder(jnp.zeros((1, 4)), support_range=2)
+
+
+def _gae_numpy(rewards, values, dones, next_value, gamma, lam):
+    """Spec oracle: the reference's reversed python loop (utils/utils.py:63-103)."""
+    T = rewards.shape[0]
+    advantages = np.zeros_like(rewards)
+    lastgaelam = 0.0
+    not_dones = 1.0 - dones
+    nextnonterminal = not_dones[-1]
+    nextvalues = next_value
+    for t in reversed(range(T)):
+        if t < T - 1:
+            nextnonterminal = not_dones[t]
+            nextvalues = values[t + 1]
+        delta = rewards[t] + nextvalues * nextnonterminal * gamma - values[t]
+        lastgaelam = delta + nextnonterminal * lastgaelam * gamma * lam
+        advantages[t] = lastgaelam
+    return advantages + values, advantages
+
+
+def test_gae_matches_reference_loop():
+    rng = np.random.default_rng(1)
+    T, N = 16, 4
+    rewards = rng.normal(size=(T, N)).astype(np.float32)
+    values = rng.normal(size=(T, N)).astype(np.float32)
+    dones = (rng.uniform(size=(T, N)) < 0.15).astype(np.float32)
+    next_value = rng.normal(size=(N,)).astype(np.float32)
+    exp_ret, exp_adv = _gae_numpy(rewards, values, dones, next_value, 0.99, 0.95)
+    ret, adv = jax.jit(lambda *a: gae(*a, num_steps=T, gamma=0.99, gae_lambda=0.95))(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones), jnp.asarray(next_value)
+    )
+    np.testing.assert_allclose(np.asarray(adv), exp_adv, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ret), exp_ret, rtol=1e-4, atol=1e-4)
+
+
+def _lambda_values_numpy(rewards, values, continues, lmbda):
+    """Spec oracle: reference algos/dreamer_v3/utils.py:66-77."""
+    vals = [values[-1:]]
+    interm = rewards + continues * values * (1 - lmbda)
+    for t in reversed(range(len(continues))):
+        vals.append(interm[t : t + 1] + continues[t : t + 1] * lmbda * vals[-1])
+    return np.concatenate(list(reversed(vals))[:-1])
+
+
+def test_lambda_values_matches_reference_loop():
+    rng = np.random.default_rng(2)
+    H, B = 15, 8
+    rewards = rng.normal(size=(H, B, 1)).astype(np.float32)
+    values = rng.normal(size=(H, B, 1)).astype(np.float32)
+    continues = (rng.uniform(size=(H, B, 1)) < 0.9).astype(np.float32) * 0.997
+    expected = _lambda_values_numpy(rewards, values, continues, 0.95)
+    got = jax.jit(lambda r, v, c: compute_lambda_values(r, v, c, 0.95))(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(continues)
+    )
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_uniform_mix():
+    logits = jnp.array([[10.0, 0.0, -10.0]])
+    mixed = uniform_mix(logits, unimix=0.01)
+    probs = np.asarray(jax.nn.softmax(mixed, axis=-1))
+    assert probs.min() >= 0.01 / 3 - 1e-6
+    np.testing.assert_allclose(probs.sum(), 1.0, atol=1e-6)
+    # unimix=0 is the identity
+    np.testing.assert_allclose(np.asarray(uniform_mix(logits, 0.0)), np.asarray(logits))

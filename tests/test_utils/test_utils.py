@@ -1,0 +1,46 @@
+import pytest
+
+from sheeprl_tpu.utils.utils import Ratio, dotdict, nest_dotted, polynomial_decay
+
+
+def test_dotdict():
+    d = dotdict({"a": {"b": 1}, "c": 2})
+    assert d.a.b == 1 and d.c == 2
+    assert d.missing is None
+    d.x = {"y": 3}
+    assert d["x"]["y"] == 3
+    assert d.as_dict() == {"a": {"b": 1}, "c": 2, "x": {"y": 3}}
+    assert type(d.as_dict()["a"]) is dict
+
+
+def test_polynomial_decay():
+    assert polynomial_decay(0, initial=1.0, final=0.0, max_decay_steps=10) == 1.0
+    assert polynomial_decay(10, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
+    assert polynomial_decay(11, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
+    assert polynomial_decay(5, initial=1.0, final=0.0, max_decay_steps=10) == pytest.approx(0.5)
+
+
+def test_ratio_accumulates():
+    r = Ratio(ratio=0.5)
+    assert r(0) == 0
+    assert r(4) == 2  # (4-0)*0.5
+    assert r(8) == 2
+    state = r.state_dict()
+    r2 = Ratio(ratio=0.1).load_state_dict(state)
+    assert r2(12) == r_expected(state, 12)
+
+
+def r_expected(state, step):
+    return int((step - state["_prev"]) * state["_ratio"])
+
+
+def test_ratio_validation():
+    with pytest.raises(ValueError):
+        Ratio(-1.0)
+    with pytest.raises(ValueError):
+        Ratio(1.0, pretrain_steps=-1)
+    assert Ratio(0.0)(100) == 0
+
+
+def test_nest_dotted():
+    assert nest_dotted({"a.b.c": 1, "a.d": 2, "e": 3}) == {"a": {"b": {"c": 1}, "d": 2}, "e": 3}
