@@ -1,0 +1,247 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, ReplayBuffer, SequentialReplayBuffer
+
+
+def _data(T, n_envs, extra=()):
+    d = {
+        "observations": np.arange(T * n_envs, dtype=np.float32).reshape(T, n_envs, 1),
+        "rewards": np.ones((T, n_envs, 1), dtype=np.float32),
+    }
+    for k in extra:
+        d[k] = np.zeros((T, n_envs, 1), dtype=np.float32)
+    return d
+
+
+class TestReplayBuffer:
+    def test_invalid_init(self):
+        with pytest.raises(ValueError):
+            ReplayBuffer(0)
+        with pytest.raises(ValueError):
+            ReplayBuffer(4, n_envs=0)
+        with pytest.raises(ValueError):
+            ReplayBuffer(4, memmap=True)  # no memmap_dir
+
+    def test_add_and_wraparound(self):
+        rb = ReplayBuffer(8, n_envs=2)
+        rb.add(_data(5, 2))
+        assert rb._pos == 5 and not rb.full
+        rb.add(_data(5, 2))
+        assert rb._pos == 2 and rb.full
+        # wrap-around content: positions 0,1 hold the last two steps of second add
+        np.testing.assert_allclose(rb["observations"][1], _data(5, 2)["observations"][4])
+
+    def test_add_longer_than_buffer(self):
+        rb = ReplayBuffer(4, n_envs=1)
+        rb.add(_data(10, 1))
+        assert rb.full
+
+    def test_add_validate(self):
+        rb = ReplayBuffer(4)
+        with pytest.raises(ValueError):
+            rb.add([1, 2], validate_args=True)
+        with pytest.raises(RuntimeError):
+            rb.add({"a": np.zeros((3,))}, validate_args=True)
+        with pytest.raises(RuntimeError):
+            rb.add({"a": np.zeros((3, 1, 2)), "b": np.zeros((4, 1, 2))}, validate_args=True)
+
+    def test_sample_shapes(self):
+        rb = ReplayBuffer(16, n_envs=2)
+        rb.add(_data(8, 2))
+        s = rb.sample(6, n_samples=3)
+        assert s["observations"].shape == (3, 6, 1)
+
+    def test_sample_before_add_raises(self):
+        rb = ReplayBuffer(4)
+        with pytest.raises(ValueError):
+            rb.sample(1)
+        with pytest.raises(ValueError):
+            ReplayBuffer(4).sample(0)
+
+    def test_sample_next_obs(self):
+        rb = ReplayBuffer(8, n_envs=1)
+        rb.add(_data(8, 1))
+        s = rb.sample(4, sample_next_obs=True)
+        assert "next_observations" in s
+        # next obs is obs+1 in our arange data (no wrap into invalid pos)
+        np.testing.assert_allclose(s["next_observations"], s["observations"] + 1)
+
+    def test_sample_next_obs_single_step_raises(self):
+        rb = ReplayBuffer(8)
+        rb.add(_data(1, 1))
+        with pytest.raises(RuntimeError):
+            rb.sample(1, sample_next_obs=True)
+
+    def test_memmap_roundtrip(self, tmp_path):
+        rb = ReplayBuffer(8, n_envs=2, memmap=True, memmap_dir=tmp_path / "rb")
+        rb.add(_data(4, 2))
+        assert rb.is_memmap
+        s = rb.sample(3)
+        assert s["observations"].shape == (1, 3, 1)
+        assert (tmp_path / "rb" / "observations.memmap").exists()
+
+    def test_setitem_getitem(self):
+        rb = ReplayBuffer(4, n_envs=2)
+        with pytest.raises(RuntimeError):
+            rb["observations"]
+        rb.add(_data(2, 2))
+        rb["extra"] = np.zeros((4, 2, 3), dtype=np.float32)
+        assert rb["extra"].shape == (4, 2, 3)
+        with pytest.raises(RuntimeError):
+            rb["bad"] = np.zeros((5, 2))
+        with pytest.raises(ValueError):
+            rb["bad"] = "nope"
+        with pytest.raises(TypeError):
+            rb[3]
+
+    def test_sample_tensors_devices(self):
+        import jax.numpy as jnp
+
+        rb = ReplayBuffer(8)
+        rb.add(_data(4, 1))
+        out = rb.sample_tensors(2, dtype=jnp.bfloat16)
+        assert out["observations"].dtype == jnp.bfloat16
+
+    def test_state_dict_roundtrip(self):
+        rb = ReplayBuffer(8, n_envs=2)
+        rb.add(_data(5, 2))
+        state = rb.state_dict()
+        rb2 = ReplayBuffer(8, n_envs=2)
+        rb2.load_state_dict(state)
+        assert rb2._pos == 5
+        np.testing.assert_allclose(np.asarray(rb2["observations"]), np.asarray(rb["observations"]))
+
+
+class TestSequentialReplayBuffer:
+    def test_sample_shape_and_contiguity(self):
+        rb = SequentialReplayBuffer(32, n_envs=2)
+        rb.add(_data(20, 2))
+        s = rb.sample(4, n_samples=2, sequence_length=8)
+        assert s["observations"].shape == (2, 8, 4, 1)
+        # sequences are contiguous: obs values step by n_envs in our arange fill
+        seq = s["observations"][0, :, 0, 0]
+        diffs = np.diff(seq)
+        assert np.all(diffs == diffs[0])
+
+    def test_sequence_too_long_raises(self):
+        rb = SequentialReplayBuffer(8)
+        rb.add(_data(4, 1))
+        with pytest.raises(ValueError):
+            rb.sample(1, sequence_length=5)
+        rb.add(_data(4, 1))  # now full
+        with pytest.raises(ValueError):
+            rb.sample(1, sequence_length=9)
+
+    def test_full_buffer_valid_windows(self):
+        rb = SequentialReplayBuffer(16, n_envs=1)
+        for i in range(5):
+            d = _data(8, 1)
+            d["observations"] = (np.arange(8, dtype=np.float32) + 8 * i).reshape(8, 1, 1)
+            rb.add(d)
+        s = rb.sample(64, sequence_length=4)
+        seqs = s["observations"][0, :, :, 0].T  # [64, 4]
+        diffs = np.diff(seqs, axis=1)
+        assert np.all(diffs == 1)  # every sampled window is a real contiguous window
+
+
+class TestEnvIndependentReplayBuffer:
+    def test_add_with_indices_and_sample(self):
+        rb = EnvIndependentReplayBuffer(16, n_envs=3)
+        rb.add(_data(4, 2), indices=[0, 2])
+        with pytest.raises((RuntimeError, ValueError)):
+            rb.sample(64)  # env 1 is empty and will be selected -> sub-buffer raises
+        rb.add(_data(4, 3))
+        s = rb.sample(6)
+        assert s["observations"].shape == (1, 6, 1)
+
+    def test_add_indices_mismatch(self):
+        rb = EnvIndependentReplayBuffer(16, n_envs=3)
+        with pytest.raises(ValueError):
+            rb.add(_data(4, 2), indices=[0])
+
+    def test_sequential_cls(self):
+        rb = EnvIndependentReplayBuffer(32, n_envs=2, buffer_cls=SequentialReplayBuffer)
+        rb.add(_data(16, 2))
+        s = rb.sample(4, sequence_length=8)
+        assert s["observations"].shape == (1, 8, 4, 1)
+
+    def test_memmap(self, tmp_path):
+        rb = EnvIndependentReplayBuffer(8, n_envs=2, memmap=True, memmap_dir=tmp_path / "envs")
+        rb.add(_data(4, 2))
+        assert all(rb.is_memmap)
+        assert (tmp_path / "envs" / "env_0" / "observations.memmap").exists()
+
+
+def _episode_data(T, n_envs, done_at=None):
+    d = _data(T, n_envs)
+    d["terminated"] = np.zeros((T, n_envs, 1), dtype=np.float32)
+    d["truncated"] = np.zeros((T, n_envs, 1), dtype=np.float32)
+    if done_at is not None:
+        d["terminated"][done_at] = 1.0
+    return d
+
+
+class TestEpisodeBuffer:
+    def test_invalid_init(self):
+        with pytest.raises(ValueError):
+            EpisodeBuffer(0, 1)
+        with pytest.raises(ValueError):
+            EpisodeBuffer(8, 0)
+        with pytest.raises(ValueError):
+            EpisodeBuffer(4, 8)
+
+    def test_open_episodes_accumulate_and_close(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=2, n_envs=1)
+        eb.add(_episode_data(5, 1))  # no done: stays open
+        assert len(eb) == 0
+        eb.add(_episode_data(5, 1, done_at=4))  # closes a 10-step episode
+        assert len(eb) == 10
+
+    def test_multiple_episodes_in_one_add(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=2, n_envs=1)
+        data = _episode_data(10, 1)
+        data["terminated"][3] = 1.0
+        data["terminated"][9] = 1.0
+        eb.add(data)
+        assert len(eb._buf) == 2
+        assert len(eb) == 10
+
+    def test_eviction(self):
+        eb = EpisodeBuffer(10, minimum_episode_length=2, n_envs=1)
+        for _ in range(3):
+            eb.add(_episode_data(4, 1, done_at=3))
+        # 3 episodes of 4 steps; capacity 10 -> oldest evicted
+        assert len(eb) <= 10
+        assert len(eb._buf) == 2
+
+    def test_sample_shapes_and_bounds(self):
+        eb = EpisodeBuffer(128, minimum_episode_length=4, n_envs=2)
+        for _ in range(3):
+            eb.add(_episode_data(8, 2, done_at=7))
+        s = eb.sample(5, n_samples=2, sequence_length=4)
+        assert s["observations"].shape == (2, 4, 5, 1)
+
+    def test_sample_too_long_sequence(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=2, n_envs=1)
+        eb.add(_episode_data(4, 1, done_at=3))
+        with pytest.raises(RuntimeError):
+            eb.sample(1, sequence_length=16)
+
+    def test_short_episode_raises(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=8, n_envs=1)
+        with pytest.raises(RuntimeError):
+            eb.add(_episode_data(4, 1, done_at=3))
+
+    def test_prioritize_ends(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=2, prioritize_ends=True)
+        eb.add(_episode_data(8, 1, done_at=7))
+        s = eb.sample(16, sequence_length=4)
+        assert s["observations"].shape == (1, 4, 16, 1)
+
+    def test_memmap(self, tmp_path):
+        eb = EpisodeBuffer(64, 2, memmap=True, memmap_dir=tmp_path / "eps")
+        eb.add(_episode_data(4, 1, done_at=3))
+        assert len(eb) == 4
+        dirs = list((tmp_path / "eps").glob("episode_*"))
+        assert len(dirs) == 1

@@ -1,0 +1,64 @@
+import pickle
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.memmap import MemmapArray
+
+
+def test_create_write_read(tmp_path):
+    m = MemmapArray(shape=(4, 2), dtype=np.float32, filename=tmp_path / "a.memmap")
+    m[:] = np.ones((4, 2), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(m), np.ones((4, 2)))
+    assert m.shape == (4, 2)
+    assert len(m) == 4
+
+
+def test_requires_filename():
+    with pytest.raises(ValueError):
+        MemmapArray(shape=(2,), filename=None)
+    with pytest.raises(ValueError):
+        MemmapArray(shape=(2,), filename="x.memmap", mode="r")
+
+
+def test_from_array(tmp_path):
+    src = np.arange(6, dtype=np.int32).reshape(2, 3)
+    m = MemmapArray.from_array(src, filename=tmp_path / "b.memmap")
+    np.testing.assert_array_equal(np.asarray(m), src)
+
+
+def test_ownership_deletes_file(tmp_path):
+    path = tmp_path / "c.memmap"
+    m = MemmapArray(shape=(2,), filename=path)
+    assert path.exists()
+    del m
+    assert not path.exists()
+
+
+def test_no_ownership_keeps_file(tmp_path):
+    path = tmp_path / "d.memmap"
+    m = MemmapArray(shape=(2,), filename=path)
+    m.has_ownership = False
+    del m
+    assert path.exists()
+
+
+def test_pickle_reattaches_without_ownership(tmp_path):
+    path = tmp_path / "e.memmap"
+    m = MemmapArray(shape=(3,), dtype=np.float64, filename=path)
+    m[:] = [1.0, 2.0, 3.0]
+    m2 = pickle.loads(pickle.dumps(m))
+    np.testing.assert_allclose(np.asarray(m2), [1.0, 2.0, 3.0])
+    assert not m2.has_ownership
+    del m2
+    assert path.exists()  # non-owner must not delete
+    del m
+    assert not path.exists()
+
+
+def test_array_setter_shape_check(tmp_path):
+    m = MemmapArray(shape=(2, 2), filename=tmp_path / "f.memmap")
+    with pytest.raises(ValueError):
+        m.array = np.zeros((3, 3))
+    with pytest.raises(ValueError):
+        m.array = "nope"
