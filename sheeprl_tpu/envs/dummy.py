@@ -1,0 +1,75 @@
+"""Deterministic synthetic environments used as the test backbone
+(reference /root/reference/sheeprl/envs/dummy.py).  They produce a dict
+observation space with a ``rgb`` pixel key (CHW uint8) and a ``state`` vector
+key, across the three action-space families."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+
+class _DummyEnv(gym.Env):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 128,
+        vector_shape: Tuple[int, ...] = (10,),
+        dict_obs_space: bool = True,
+    ):
+        self._dict_obs_space = dict_obs_space
+        if dict_obs_space:
+            self.observation_space = gym.spaces.Dict(
+                {
+                    "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
+                    "state": gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32),
+                }
+            )
+        else:
+            self.observation_space = gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32)
+        self.reward_range = (-np.inf, np.inf)
+        self._current_step = 0
+        self._n_steps = n_steps
+
+    def get_obs(self):
+        if self._dict_obs_space:
+            return {
+                "rgb": np.full(self.observation_space["rgb"].shape, self._current_step % 256, dtype=np.uint8),
+                "state": np.full(self.observation_space["state"].shape, self._current_step % 20, dtype=np.float32),
+            }
+        return np.full(self.observation_space.shape, self._current_step % 20, dtype=np.float32)
+
+    def step(self, action):
+        done = self._current_step == self._n_steps
+        self._current_step += 1
+        return self.get_obs(), 0.0, done, False, {}
+
+    def reset(self, seed=None, options=None):
+        self._current_step = 0
+        return self.get_obs(), {}
+
+    def render(self):
+        return np.zeros((64, 64, 3), dtype=np.uint8)
+
+    def close(self):
+        pass
+
+
+class ContinuousDummyEnv(_DummyEnv):
+    def __init__(self, action_dim: int = 2, **kwargs):
+        self.action_space = gym.spaces.Box(-np.inf, np.inf, shape=(action_dim,))
+        super().__init__(**kwargs)
+
+
+class DiscreteDummyEnv(_DummyEnv):
+    def __init__(self, action_dim: int = 2, n_steps: int = 4, **kwargs):
+        self.action_space = gym.spaces.Discrete(action_dim)
+        super().__init__(n_steps=n_steps, **kwargs)
+
+
+class MultiDiscreteDummyEnv(_DummyEnv):
+    def __init__(self, action_dims: List[int] = [2, 2], **kwargs):
+        self.action_space = gym.spaces.MultiDiscrete(action_dims)
+        super().__init__(**kwargs)

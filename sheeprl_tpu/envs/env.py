@@ -1,0 +1,245 @@
+"""Environment factory: dict-obs normalization + wrapper-stack assembly.
+
+Behavioral equivalent of /root/reference/sheeprl/utils/env.py:26-249, written
+against gymnasium >= 1.0.  Every env is normalized to a ``gym.spaces.Dict``
+observation space; pixel keys go through the cv2 pipeline (resize, optional
+grayscale, CHW uint8) so buffers store the same layout the reference does.
+Vectorization is gymnasium Sync/AsyncVectorEnv picked by ``cfg.env.sync_env``
+— on a TPU-VM the async workers are the host-CPU actor parallelism.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+import cv2
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    MaskVelocityWrapper,
+    RewardAsObservationWrapper,
+)
+
+
+class _DictObs(gym.ObservationWrapper):
+    """Wrap a single Box observation under a named key."""
+
+    def __init__(self, env: gym.Env, key: str):
+        super().__init__(env)
+        self._key = key
+        self.observation_space = gym.spaces.Dict({key: env.observation_space})
+
+    def observation(self, observation):
+        return {self._key: observation}
+
+
+class _RenderPixels(gym.Wrapper):
+    """Add a pixel key from env.render() for vector-obs envs when the config
+    asks for a cnn encoder (replaces gym 0.29 PixelObservationWrapper)."""
+
+    def __init__(self, env: gym.Env, pixel_key: str, state_key: Optional[str] = None):
+        super().__init__(env)
+        self._pixel_key = pixel_key
+        self._state_key = state_key
+        frame = env.render()
+        if frame is None:
+            raise RuntimeError(
+                f"Cannot build pixel observations for '{env}' because render() returned None; "
+                "construct the env with render_mode='rgb_array'"
+            )
+        frame = np.asarray(frame)
+        spaces = {pixel_key: gym.spaces.Box(0, 255, frame.shape, np.uint8)}
+        if state_key is not None:
+            spaces[state_key] = env.observation_space
+        self.observation_space = gym.spaces.Dict(spaces)
+
+    def _obs(self, observation):
+        out = {self._pixel_key: np.asarray(self.env.render(), dtype=np.uint8)}
+        if self._state_key is not None:
+            out[self._state_key] = observation
+        return out
+
+    def step(self, action):
+        obs, reward, done, truncated, info = self.env.step(action)
+        return self._obs(obs), reward, done, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._obs(obs), info
+
+
+class _PixelPipeline(gym.ObservationWrapper):
+    """cv2 resize + optional grayscale + CHW uint8 for each cnn key
+    (reference utils/env.py:161-203)."""
+
+    def __init__(self, env: gym.Env, cnn_keys, screen_size: int, grayscale: bool):
+        super().__init__(env)
+        self._cnn_keys = cnn_keys
+        self._screen_size = screen_size
+        self._grayscale = grayscale
+        self.observation_space = gym.spaces.Dict(dict(env.observation_space.spaces))
+        for k in cnn_keys:
+            self.observation_space[k] = gym.spaces.Box(
+                0, 255, (1 if grayscale else 3, screen_size, screen_size), np.uint8
+            )
+
+    def observation(self, obs):
+        for k in self._cnn_keys:
+            current = np.asarray(obs[k])
+            shape = current.shape
+            is_3d = len(shape) == 3
+            is_grayscale = not is_3d or shape[0] == 1 or shape[-1] == 1
+            channel_first = not is_3d or shape[0] in (1, 3)
+            if not is_3d:
+                current = np.expand_dims(current, axis=0)
+            if channel_first:
+                current = np.transpose(current, (1, 2, 0))
+            if current.shape[:-1] != (self._screen_size, self._screen_size):
+                current = cv2.resize(
+                    current, (self._screen_size, self._screen_size), interpolation=cv2.INTER_AREA
+                )
+            if self._grayscale and not is_grayscale:
+                current = cv2.cvtColor(current, cv2.COLOR_RGB2GRAY)
+            if current.ndim == 2:
+                current = np.expand_dims(current, axis=-1)
+                if not self._grayscale:
+                    current = np.repeat(current, 3, axis=-1)
+            obs[k] = np.ascontiguousarray(current.transpose(2, 0, 1), dtype=np.uint8)
+        return obs
+
+
+def make_env(
+    cfg: Dict[str, Any],
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    """Build a thunk creating one fully-wrapped env (reference utils/env.py:26-237)."""
+
+    def thunk() -> gym.Env:
+        wrapper_cfg = dict(cfg.env.wrapper)
+        instantiate_kwargs = {}
+        if "seed" in wrapper_cfg:
+            instantiate_kwargs["seed"] = seed
+        if "rank" in wrapper_cfg:
+            instantiate_kwargs["rank"] = rank + vector_env_idx
+        env = instantiate(wrapper_cfg, **instantiate_kwargs)
+
+        if cfg.env.action_repeat > 1:
+            env = ActionRepeat(env, cfg.env.action_repeat)
+        if cfg.env.get("mask_velocities", False):
+            env = MaskVelocityWrapper(env)
+
+        cnn_encoder_keys = cfg.algo.cnn_keys.encoder
+        mlp_encoder_keys = cfg.algo.mlp_keys.encoder
+        if not (
+            isinstance(mlp_encoder_keys, list)
+            and isinstance(cnn_encoder_keys, list)
+            and len(cnn_encoder_keys + mlp_encoder_keys) > 0
+        ):
+            raise ValueError(
+                "`algo.cnn_keys.encoder` and `algo.mlp_keys.encoder` must be lists of strings with at "
+                f"least one total key, got: cnn={cnn_encoder_keys} mlp={mlp_encoder_keys}"
+            )
+
+        # Normalize the observation space to a Dict
+        if isinstance(env.observation_space, gym.spaces.Box) and len(env.observation_space.shape) < 2:
+            if len(cnn_encoder_keys) > 0:
+                if len(cnn_encoder_keys) > 1:
+                    warnings.warn(f"Only the first cnn key is kept for {cfg.env.id}: {cnn_encoder_keys[0]}")
+                state_key = mlp_encoder_keys[0] if len(mlp_encoder_keys) > 0 else None
+                env = _RenderPixels(env, pixel_key=cnn_encoder_keys[0], state_key=state_key)
+            else:
+                if len(mlp_encoder_keys) > 1:
+                    warnings.warn(f"Only the first mlp key is kept for {cfg.env.id}: {mlp_encoder_keys[0]}")
+                env = _DictObs(env, mlp_encoder_keys[0])
+        elif isinstance(env.observation_space, gym.spaces.Box) and 2 <= len(env.observation_space.shape) <= 3:
+            if len(cnn_encoder_keys) == 0:
+                raise ValueError(
+                    "You have selected a pixel observation but no cnn key has been specified. "
+                    "Set `algo.cnn_keys.encoder=[your_cnn_key]`"
+                )
+            if len(cnn_encoder_keys) > 1:
+                warnings.warn(f"Only the first cnn key is kept for {cfg.env.id}: {cnn_encoder_keys[0]}")
+            env = _DictObs(env, cnn_encoder_keys[0])
+
+        requested = set(mlp_encoder_keys + cnn_encoder_keys)
+        if len(requested.intersection(env.observation_space.keys())) == 0:
+            raise ValueError(
+                f"The user-specified keys {sorted(requested)} are not a subset of the environment "
+                f"observation keys {sorted(env.observation_space.keys())}. Check your config."
+            )
+
+        env_cnn_keys = set(
+            k for k in env.observation_space.spaces.keys() if len(env.observation_space[k].shape) in (2, 3)
+        )
+        cnn_keys = sorted(env_cnn_keys.intersection(cnn_encoder_keys))
+        if cnn_keys:
+            env = _PixelPipeline(env, cnn_keys, cfg.env.screen_size, cfg.env.grayscale)
+            if cfg.env.frame_stack > 1:
+                if cfg.env.frame_stack_dilation <= 0:
+                    raise ValueError(
+                        f"The frame stack dilation argument must be greater than zero, "
+                        f"got: {cfg.env.frame_stack_dilation}"
+                    )
+                env = FrameStack(env, cfg.env.frame_stack, cnn_keys, cfg.env.frame_stack_dilation)
+
+        if cfg.env.actions_as_observation.num_stack > 0:
+            env = ActionsAsObservationWrapper(env, **cfg.env.actions_as_observation)
+        if cfg.env.reward_as_observation:
+            env = RewardAsObservationWrapper(env)
+
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        if cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
+            if cfg.env.grayscale:
+                env = GrayscaleRenderWrapper(env)
+            try:
+                env = gym.wrappers.RecordVideo(
+                    env,
+                    os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
+                    disable_logger=True,
+                )
+            except Exception as err:  # moviepy may be missing in minimal images
+                warnings.warn(f"Video capture disabled: {err}")
+        return env
+
+    return thunk
+
+
+def vectorized_env(env_fns, sync: bool = True) -> gym.vector.VectorEnv:
+    """SyncVectorEnv or AsyncVectorEnv (one OS subprocess per env — the
+    reference's actor parallelism, utils/env.py + e.g. algos/ppo/ppo.py:137)."""
+    if sync or len(env_fns) == 1:
+        return gym.vector.SyncVectorEnv(env_fns)
+    return gym.vector.AsyncVectorEnv(env_fns)
+
+
+def get_dummy_env(id: str) -> gym.Env:
+    """Dummy env selector (reference utils/env.py:240-249)."""
+    if "continuous" in id:
+        from sheeprl_tpu.envs.dummy import ContinuousDummyEnv
+
+        return ContinuousDummyEnv()
+    elif "multidiscrete" in id:
+        from sheeprl_tpu.envs.dummy import MultiDiscreteDummyEnv
+
+        return MultiDiscreteDummyEnv()
+    elif "discrete" in id:
+        from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+
+        return DiscreteDummyEnv()
+    raise ValueError(f"Unrecognized dummy environment: {id}")

@@ -1,0 +1,164 @@
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from sheeprl_tpu.envs.env import get_dummy_env, make_env, vectorized_env
+from sheeprl_tpu.envs.wrappers import ActionRepeat, ActionsAsObservationWrapper, FrameStack, RestartOnException
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def _cfg(**env_overrides):
+    env = {
+        "id": "discrete_dummy",
+        "num_envs": 2,
+        "frame_stack": 1,
+        "sync_env": True,
+        "screen_size": 64,
+        "action_repeat": 1,
+        "grayscale": False,
+        "clip_rewards": False,
+        "capture_video": False,
+        "frame_stack_dilation": 1,
+        "actions_as_observation": {"num_stack": -1, "noop": 0, "dilation": 1},
+        "max_episode_steps": None,
+        "reward_as_observation": False,
+        "wrapper": {"_target_": "sheeprl_tpu.envs.env.get_dummy_env", "id": "discrete_dummy"},
+    }
+    env.update(env_overrides)
+    return dotdict(
+        {
+            "env": env,
+            "algo": {"cnn_keys": {"encoder": ["rgb"]}, "mlp_keys": {"encoder": ["state"]}},
+        }
+    )
+
+
+def test_dummy_envs_step():
+    for env in (DiscreteDummyEnv(), ContinuousDummyEnv(), MultiDiscreteDummyEnv()):
+        obs, _ = env.reset()
+        assert set(obs.keys()) == {"rgb", "state"}
+        obs, r, d, t, i = env.step(env.action_space.sample())
+        assert obs["rgb"].dtype == np.uint8
+
+
+def test_get_dummy_env_selector():
+    assert isinstance(get_dummy_env("continuous_dummy"), ContinuousDummyEnv)
+    assert isinstance(get_dummy_env("multidiscrete_dummy"), MultiDiscreteDummyEnv)
+    assert isinstance(get_dummy_env("discrete_dummy"), DiscreteDummyEnv)
+    with pytest.raises(ValueError):
+        get_dummy_env("bogus")
+
+
+def test_make_env_dict_obs_and_pixel_pipeline():
+    env = make_env(_cfg(screen_size=32), seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (3, 32, 32) and obs["rgb"].dtype == np.uint8
+    assert obs["state"].shape == (10,)
+    obs, *_ = env.step(env.action_space.sample())
+    assert obs["rgb"].shape == (3, 32, 32)
+
+
+def test_make_env_grayscale_and_frame_stack():
+    env = make_env(_cfg(grayscale=True, frame_stack=4), seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (4, 1, 64, 64)
+
+
+def test_make_env_vector_only_env():
+    cfg = _cfg(wrapper={"_target_": "gymnasium.make", "id": "CartPole-v1"}, id="CartPole-v1")
+    cfg.algo.cnn_keys.encoder = []
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert set(obs.keys()) >= {"state"}
+    assert obs["state"].shape == (4,)
+
+
+def test_make_env_requires_keys():
+    cfg = _cfg()
+    cfg.algo.cnn_keys.encoder = []
+    cfg.algo.mlp_keys.encoder = []
+    with pytest.raises(ValueError):
+        make_env(cfg, seed=0, rank=0)()
+
+
+def test_make_env_key_mismatch_raises():
+    cfg = _cfg()
+    cfg.algo.cnn_keys.encoder = ["nope_cnn"]
+    cfg.algo.mlp_keys.encoder = ["nope_mlp"]
+    with pytest.raises(ValueError):
+        make_env(cfg, seed=0, rank=0)()
+
+
+def test_reward_and_actions_as_observation():
+    cfg = _cfg(
+        reward_as_observation=True,
+        actions_as_observation={"num_stack": 3, "noop": 0, "dilation": 1},
+    )
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert "reward" in obs and obs["reward"].shape == (1,)
+    assert obs["action_stack"].shape == (6,)  # 2 actions one-hot x 3 stack
+
+
+def test_max_episode_steps():
+    env = make_env(_cfg(max_episode_steps=3), seed=0, rank=0)()
+    env.reset()
+    t = False
+    for _ in range(3):
+        *_, term, t, _ = env.step(env.action_space.sample())
+    assert t  # truncated by TimeLimit
+
+
+def test_action_repeat():
+    class CountEnv(gym.Env):
+        observation_space = gym.spaces.Box(-1, 1, (1,))
+        action_space = gym.spaces.Discrete(2)
+
+        def __init__(self):
+            self.count = 0
+
+        def reset(self, seed=None, options=None):
+            return np.zeros(1, np.float32), {}
+
+        def step(self, action):
+            self.count += 1
+            return np.zeros(1, np.float32), 1.0, False, False, {}
+
+    env = ActionRepeat(CountEnv(), 4)
+    with pytest.raises(ValueError):
+        ActionRepeat(CountEnv(), 0)
+    env.reset()
+    _, reward, *_ = env.step(0)
+    assert reward == 4.0 and env.unwrapped.count == 4
+
+
+def test_restart_on_exception():
+    calls = {"n": 0}
+
+    class FlakyEnv(gym.Env):
+        observation_space = gym.spaces.Box(-1, 1, (1,))
+        action_space = gym.spaces.Discrete(2)
+
+        def reset(self, seed=None, options=None):
+            return np.zeros(1, np.float32), {}
+
+        def step(self, action):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("boom")
+            return np.zeros(1, np.float32), 0.0, False, False, {}
+
+    env = RestartOnException(lambda: FlakyEnv(), wait=0)
+    env.reset()
+    obs, reward, done, trunc, info = env.step(0)
+    assert info.get("restart_on_exception") is True
+
+
+def test_vectorized_env_sync():
+    cfg = _cfg()
+    envs = vectorized_env([make_env(cfg, seed=i, rank=0, vector_env_idx=i) for i in range(2)], sync=True)
+    obs, _ = envs.reset()
+    assert obs["rgb"].shape == (2, 3, 64, 64)
+    obs, *_ = envs.step(envs.action_space.sample())
+    assert obs["state"].shape == (2, 10)
