@@ -1,0 +1,48 @@
+"""Benchmark harness: prints ONE JSON line
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+Mirrors the reference's wall-clock benchmark (reference
+/root/reference/benchmarks/benchmark.py + configs/exp/ppo_benchmarks.yaml):
+PPO on CartPole-v1, 65536 env steps, logging/test/checkpoint disabled.
+Baseline: SheepRL v0.5.5 on 4 CPUs = 81.27 s (BASELINE.md §B), i.e.
+~806 env-steps/s. ``vs_baseline`` is the throughput ratio (ours / reference,
+higher is better).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+PPO_BASELINE_SECONDS = 81.27  # reference 1-device wall clock (BASELINE.md §B)
+TOTAL_STEPS = 65536
+
+
+def main() -> None:
+    from sheeprl_tpu.cli import run
+
+    args = [
+        "exp=ppo_benchmarks",
+        "env.capture_video=False",
+        "checkpoint.save_last=False",
+    ]
+    tic = time.perf_counter()
+    run(args)
+    elapsed = time.perf_counter() - tic
+    sps = TOTAL_STEPS / elapsed
+    baseline_sps = TOTAL_STEPS / PPO_BASELINE_SECONDS
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_env_steps_per_sec",
+                "value": round(sps, 2),
+                "unit": "env-steps/s",
+                "vs_baseline": round(sps / baseline_sps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Repo-root shim so ``python sheeprl.py ...`` works like the reference's
+root-level launcher (reference /root/reference/sheeprl.py)."""
+
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,6 @@
+"""``python -m sheeprl_tpu`` → train CLI (reference sheeprl/__main__.py)."""
+
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    run()

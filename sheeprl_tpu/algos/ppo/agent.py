@@ -1,0 +1,231 @@
+"""PPO agent: encoder + actor + critic as one flax module.
+
+Behavioral equivalent of /root/reference/sheeprl/algos/ppo/agent.py:20-369,
+redesigned functionally for TPU: the agent is a pure ``init/apply`` module over
+a params pytree; there is no DDP wrapper and no separate "player" copy with
+tied weights (reference agent.py:369-430) — the player simply applies the same
+params, which are values, not objects.
+
+Action-space handling (reference agent.py:92-200):
+- continuous (``normal``/``tanh_normal``): one head emitting mean and log-std;
+- discrete / multi-discrete: one logits head per action sub-space.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sheeprl_tpu.models.blocks import MLP, NatureCNN, cnn_forward
+from sheeprl_tpu.ops.distributions import Categorical, Normal, TanhNormal
+
+
+class _CNNEncoder(nn.Module):
+    """NatureCNN over the channel-concat of pixel keys (reference agent.py:20-36)."""
+
+    features_dim: int
+    keys: Sequence[str]
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-3) / 255.0
+        return cnn_forward(NatureCNN(features_dim=self.features_dim), x)
+
+
+class _MLPEncoder(nn.Module):
+    """Dense encoder over the feature-concat of vector keys (reference agent.py:39-70)."""
+
+    keys: Sequence[str]
+    features_dim: Optional[int]
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: str = "tanh"
+    layer_norm: bool = False
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        if self.mlp_layers == 0:
+            return x
+        return MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            output_dim=self.features_dim,
+            activation=self.dense_act,
+            layer_norm=self.layer_norm,
+        )(x)
+
+
+class PPOAgent(nn.Module):
+    """Feature extractor + actor heads + critic (reference agent.py:92-366)."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    distribution: str = "auto"
+    cnn_keys: Sequence[str] = ()
+    mlp_keys: Sequence[str] = ()
+    mlp_input_dim: int = 0
+    encoder_cfg: Any = None
+    actor_cfg: Any = None
+    critic_cfg: Any = None
+
+    def setup(self) -> None:
+        dist = self.distribution.lower()
+        if dist not in ("auto", "normal", "tanh_normal", "discrete"):
+            raise ValueError(
+                f"The distribution must be one of: `auto`, `discrete`, `normal` and `tanh_normal`. Found: {dist}"
+            )
+        if dist == "discrete" and self.is_continuous:
+            raise ValueError("You have chosen a discrete distribution but `is_continuous` is true")
+        if dist in ("normal", "tanh_normal") and not self.is_continuous:
+            raise ValueError("You have chosen a continuous distribution but `is_continuous` is false")
+        self.dist = ("normal" if self.is_continuous else "discrete") if dist == "auto" else dist
+
+        enc = self.encoder_cfg
+        self._cnn_enc = (
+            _CNNEncoder(features_dim=enc["cnn_features_dim"], keys=tuple(self.cnn_keys)) if self.cnn_keys else None
+        )
+        self._mlp_enc = (
+            _MLPEncoder(
+                keys=tuple(self.mlp_keys),
+                features_dim=enc["mlp_features_dim"],
+                dense_units=enc["dense_units"],
+                mlp_layers=enc["mlp_layers"],
+                dense_act=enc["dense_act"],
+                layer_norm=enc["layer_norm"],
+            )
+            if self.mlp_keys
+            else None
+        )
+        a = self.actor_cfg
+        self.actor_backbone = MLP(
+            hidden_sizes=[a["dense_units"]] * a["mlp_layers"],
+            activation=a["dense_act"],
+            layer_norm=a["layer_norm"],
+        )
+        if self.is_continuous:
+            self.actor_heads = [nn.Dense(sum(self.actions_dim) * 2)]
+        else:
+            self.actor_heads = [nn.Dense(d) for d in self.actions_dim]
+        c = self.critic_cfg
+        self.critic = MLP(
+            hidden_sizes=[c["dense_units"]] * c["mlp_layers"],
+            output_dim=1,
+            activation=c["dense_act"],
+            layer_norm=c["layer_norm"],
+        )
+
+    def _features(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        feats = []
+        if self._cnn_enc is not None:
+            feats.append(self._cnn_enc(obs))
+        if self._mlp_enc is not None:
+            feats.append(self._mlp_enc(obs))
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+    def __call__(
+        self,
+        obs: Dict[str, jax.Array],
+        key: Optional[jax.Array] = None,
+        actions: Optional[jax.Array] = None,
+        greedy: bool = False,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Return ``(actions, log_prob, entropy, value)``.
+
+        When ``actions`` is given, evaluates their log-prob/entropy (train
+        path, reference agent.py:202-263); otherwise samples with ``key``
+        (rollout path) or takes the mode (``greedy``, test path).
+        """
+        feat = self._features(obs)
+        value = self.critic(feat)
+        pre = self.actor_backbone(feat)
+        outs = [head(pre) for head in self.actor_heads]
+        if self.is_continuous:
+            mean, log_std = jnp.split(outs[0], 2, axis=-1)
+            std = jnp.exp(log_std)
+            if self.dist == "tanh_normal":
+                dist = TanhNormal(mean, std, event_dims=1)
+            else:
+                dist = Normal(mean, std, event_dims=1)
+            if actions is None:
+                actions = dist.mode if greedy else dist.rsample(key)
+            log_prob = dist.log_prob(actions)
+            if self.dist == "tanh_normal":
+                # tanh-normal entropy has no closed form; use -log_prob of the sample
+                entropy = -log_prob
+            else:
+                entropy = dist.entropy()
+            return actions, log_prob, entropy, value
+        # discrete / multi-discrete: one categorical per sub-action
+        sampled: List[jax.Array] = []
+        log_probs: List[jax.Array] = []
+        entropies: List[jax.Array] = []
+        split_actions = (
+            jnp.split(actions, len(self.actions_dim), axis=-1) if actions is not None else [None] * len(outs)
+        )
+        for i, logits in enumerate(outs):
+            dist = Categorical(logits=logits)
+            if split_actions[i] is None:
+                if greedy:
+                    act_idx = jnp.argmax(logits, axis=-1)
+                else:
+                    sub_key = jax.random.fold_in(key, i)
+                    act_idx = dist.sample(sub_key)
+                act = act_idx[..., None].astype(jnp.float32)
+            else:
+                act = split_actions[i]
+                act_idx = act[..., 0].astype(jnp.int32)
+            sampled.append(act)
+            log_probs.append(dist.log_prob(act_idx)[..., None])
+            entropies.append(dist.entropy()[..., None])
+        return (
+            jnp.concatenate(sampled, axis=-1),
+            jnp.sum(jnp.concatenate(log_probs, axis=-1), axis=-1, keepdims=True),
+            jnp.sum(jnp.concatenate(entropies, axis=-1), axis=-1, keepdims=True),
+            value,
+        )
+
+    def get_values(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        return self.critic(self._features(obs))
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space: gymnasium.spaces.Dict,
+    agent_state: Optional[Dict[str, Any]] = None,
+):
+    """Create the agent module + its params (reference agent.py:369-430).
+
+    Returns ``(agent_module, params, sample_obs)``.  ``sample_obs`` is a dict
+    of zero arrays used to (re)trace jitted applies.
+    """
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_input_dim = int(sum(prod(obs_space[k].shape) for k in mlp_keys))
+    agent = PPOAgent(
+        actions_dim=tuple(int(a) for a in actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.type,
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        mlp_input_dim=mlp_input_dim,
+        encoder_cfg=cfg.algo.encoder,
+        actor_cfg=cfg.algo.actor,
+        critic_cfg=cfg.algo.critic,
+    )
+    sample_obs = {}
+    for k in cnn_keys:
+        sample_obs[k] = jnp.zeros((1,) + tuple(obs_space[k].shape), dtype=jnp.float32)
+    for k in mlp_keys:
+        sample_obs[k] = jnp.zeros((1, prod(obs_space[k].shape)), dtype=jnp.float32)
+    params = agent.init(jax.random.PRNGKey(int(cfg.seed or 0)), sample_obs, key=jax.random.PRNGKey(0))
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    return agent, params, sample_obs

@@ -1,0 +1,57 @@
+"""PPO losses (reference /root/reference/sheeprl/algos/ppo/loss.py).
+
+Pure functions of arrays — designed to be called inside the jitted train step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction == "none":
+        return x
+    raise ValueError(f"Unrecognized reduction: {reduction}")
+
+
+def policy_loss(
+    new_logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    advantages: jax.Array,
+    clip_coef: float | jax.Array,
+    reduction: str = "mean",
+) -> jax.Array:
+    """Clipped-surrogate policy loss (reference loss.py:9-38)."""
+    logratio = new_logprobs - old_logprobs
+    ratio = jnp.exp(logratio)
+    pg_loss1 = -advantages * ratio
+    pg_loss2 = -advantages * jnp.clip(ratio, 1 - clip_coef, 1 + clip_coef)
+    return _reduce(jnp.maximum(pg_loss1, pg_loss2), reduction)
+
+
+def value_loss(
+    new_values: jax.Array,
+    old_values: jax.Array,
+    returns: jax.Array,
+    clip_coef: float | jax.Array,
+    clip_vloss: bool,
+    reduction: str = "mean",
+) -> jax.Array:
+    """Value loss, optionally clipped around the rollout values
+    (reference loss.py:41-66)."""
+    if not clip_vloss:
+        return _reduce(0.5 * (new_values - returns) ** 2, reduction)
+    v_loss_unclipped = (new_values - returns) ** 2
+    v_clipped = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+    v_loss_clipped = (v_clipped - returns) ** 2
+    return _reduce(0.5 * jnp.maximum(v_loss_unclipped, v_loss_clipped), reduction)
+
+
+def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:
+    """Negative entropy bonus (reference loss.py:69-76)."""
+    return _reduce(-entropy, reduction)

@@ -1,0 +1,31 @@
+"""Render the algorithm registry as a table
+(reference /root/reference/sheeprl/available_agents.py:7-34)."""
+
+from __future__ import annotations
+
+import sheeprl_tpu  # noqa: F401  (fires registration)
+from sheeprl_tpu.utils.registry import algorithm_registry
+
+
+def available_agents() -> None:
+    try:
+        from rich.console import Console
+        from rich.table import Table
+
+        table = Table(title="SheepRL-TPU Agents")
+        table.add_column("Module")
+        table.add_column("Algorithm")
+        table.add_column("Entrypoint")
+        table.add_column("Decoupled")
+        for module, metadata in algorithm_registry.items():
+            for m in metadata:
+                table.add_row(module, m["name"], m["entrypoint"], str(m["decoupled"]))
+        Console().print(table)
+    except ImportError:  # pragma: no cover
+        for module, metadata in algorithm_registry.items():
+            for m in metadata:
+                print(f"{module}: {m['name']} ({m['entrypoint']}, decoupled={m['decoupled']})")
+
+
+if __name__ == "__main__":
+    available_agents()

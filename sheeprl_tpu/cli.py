@@ -1,0 +1,224 @@
+"""CLI entrypoints: train / evaluate / register / list-agents.
+
+TPU-native equivalent of /root/reference/sheeprl/cli.py:23-450.  The reference
+wraps Hydra (`@hydra.main`) and Lightning Fabric (`fabric.launch` spawns one
+process per device); here config composition is :func:`sheeprl_tpu.config.compose`
+and there is nothing to spawn — JAX is single-controller, the `Runtime` mesh
+already spans every local chip (ICI) and, under `jax.distributed`, every host.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import pathlib
+import sys
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence
+
+import yaml
+
+from sheeprl_tpu.config import compose, instantiate
+from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry, find_algorithm, find_evaluation
+from sheeprl_tpu.utils.utils import dotdict, nest_dotted, print_config
+
+
+def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+    """Merge the saved run config when resuming (reference cli.py:23-57).
+
+    The checkpoint's archived ``config.yaml`` is the base; the user may only
+    change a restricted set of keys (the reference warns and keeps the ckpt
+    value for the rest).
+    """
+    ckpt_path = pathlib.Path(cfg.checkpoint.resume_from)
+    old_cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not old_cfg_path.is_file():
+        raise FileNotFoundError(
+            f"Cannot resume from '{ckpt_path}': archived config '{old_cfg_path}' not found"
+        )
+    with open(old_cfg_path) as fp:
+        old_cfg = dotdict(yaml.safe_load(fp))
+    if old_cfg.env.id != cfg.env.id:
+        raise ValueError(
+            f"This experiment is run with a different environment from the one of the experiment "
+            f"you want to restart: got '{cfg.env.id}', expected '{old_cfg.env.id}'"
+        )
+    if old_cfg.algo.name != cfg.algo.name:
+        raise ValueError(
+            f"This experiment is run with a different algorithm from the one of the experiment "
+            f"you want to restart: got '{cfg.algo.name}', expected '{old_cfg.algo.name}'"
+        )
+    # keys the user is allowed to override on resume
+    allowed = {"checkpoint", "fabric", "metric", "run_name", "exp_name", "seed", "dry_run", "total_steps"}
+    merged = dotdict(old_cfg)
+    for key in allowed:
+        if key in cfg:
+            merged[key] = cfg[key]
+    merged.checkpoint.resume_from = str(ckpt_path)
+    merged.root_dir = old_cfg.root_dir
+    return merged
+
+
+def check_configs(cfg: dotdict) -> None:
+    """Config validation (reference cli.py:271-345)."""
+    algo_name = cfg.algo.name
+    entry = find_algorithm(algo_name)
+    if entry is None:
+        registered = sorted({m["name"] for v in algorithm_registry.values() for m in v})
+        raise ValueError(
+            f"Algorithm '{algo_name}' is not registered. Available algorithms: {registered}"
+        )
+    devices = cfg.fabric.devices
+    if entry["decoupled"]:
+        n = devices if isinstance(devices, int) else 0
+        if isinstance(devices, str) and devices not in ("auto", "-1"):
+            n = int(devices)
+        if isinstance(n, int) and 0 < n < 2:
+            raise RuntimeError(
+                f"Decoupled algorithm '{algo_name}' needs at least 2 devices "
+                f"(1 player + >=1 trainer), got fabric.devices={devices}"
+            )
+    if cfg.metric.log_level not in (0, 1):
+        raise ValueError(f"metric.log_level must be 0 or 1, got {cfg.metric.log_level}")
+
+
+def check_configs_evaluation(cfg: dotdict) -> None:
+    if cfg.checkpoint_path is None:
+        raise ValueError("You must specify the evaluation checkpoint path: checkpoint_path=...")
+
+
+def run_algorithm(cfg: dotdict) -> None:
+    """Registry lookup → runtime instantiation → entrypoint launch
+    (reference cli.py:60-199)."""
+    entry = find_algorithm(cfg.algo.name)
+    if entry is None:
+        raise ValueError(f"Algorithm '{cfg.algo.name}' is not registered")
+    module = importlib.import_module(entry["module"])
+    entrypoint = getattr(module, entry["entrypoint"])
+
+    # Algo utils module exposes AGGREGATOR_KEYS / MODELS_TO_REGISTER
+    # (reference cli.py:151-181): prune metric + model-manager config to what
+    # the algorithm actually produces.
+    utils_module_name = entry["module"].rsplit(".", 1)[0] + ".utils"
+    try:
+        algo_utils = importlib.import_module(utils_module_name)
+    except ModuleNotFoundError:
+        algo_utils = None
+    if algo_utils is not None:
+        keys = getattr(algo_utils, "AGGREGATOR_KEYS", None)
+        metrics_cfg = cfg.metric.aggregator.get("metrics", {})
+        if keys is not None and isinstance(metrics_cfg, dict):
+            cfg.metric.aggregator.metrics = dotdict(
+                {k: v for k, v in metrics_cfg.items() if k in keys}
+            )
+        models = getattr(algo_utils, "MODELS_TO_REGISTER", None)
+        mm = cfg.model_manager.get("models", {})
+        if models is not None and isinstance(mm, dict):
+            cfg.model_manager.models = dotdict({k: v for k, v in mm.items() if k in models})
+
+    runtime = instantiate(cfg.fabric)
+    runtime.launch(entrypoint, cfg)
+
+
+def run(args: Optional[Sequence[str]] = None) -> None:
+    """Train entrypoint (reference cli.py:358-366).  ``args`` defaults to
+    ``sys.argv[1:]`` — Hydra-style ``group=option``/``a.b=v`` overrides."""
+    overrides = list(args if args is not None else sys.argv[1:])
+    cfg = compose(overrides)
+    if cfg.get("num_threads"):
+        os.environ.setdefault("XLA_FLAGS", "")
+    if cfg.checkpoint.resume_from:
+        cfg = resume_from_checkpoint(cfg)
+    print_config(cfg)
+    check_configs(cfg)
+    _apply_global_flags(cfg)
+    run_algorithm(cfg)
+
+
+def _apply_global_flags(cfg: dotdict) -> None:
+    """Determinism/precision flags (reference cli.py:187-197 seeds torch and
+    sets deterministic algorithms; here: matmul precision + PRNG seeding is
+    done per-runtime in `seed_everything`)."""
+    import jax
+
+    precision = cfg.get("matmul_precision", "default")
+    if precision and precision != "default":
+        jax.config.update("jax_default_matmul_precision", precision)
+
+
+def eval_algorithm(cfg: dotdict) -> None:
+    """Evaluation launch (reference cli.py:202-268)."""
+    entry = find_evaluation(cfg.algo.name)
+    if entry is None:
+        registered = sorted({m["name"] for v in evaluation_registry.values() for m in v})
+        raise ValueError(
+            f"Evaluation for algorithm '{cfg.algo.name}' is not registered. Available: {registered}"
+        )
+    module = importlib.import_module(entry["module"])
+    entrypoint = getattr(module, entry["entrypoint"])
+    runtime = instantiate(cfg.fabric)
+    state = runtime.load(cfg.checkpoint_path)
+    runtime.launch(entrypoint, cfg, state)
+
+
+def evaluation(args: Optional[Sequence[str]] = None) -> None:
+    """Eval entrypoint ``sheeprl-eval`` (reference cli.py:369-405): loads the
+    checkpoint's archived config, merges user overrides, forces one device."""
+    overrides = list(args if args is not None else sys.argv[1:])
+    flat: Dict[str, Any] = {}
+    for ov in overrides:
+        key, _, value = ov.partition("=")
+        flat[key.lstrip("+")] = yaml.safe_load(value) if value != "" else None
+    if "checkpoint_path" not in flat or flat["checkpoint_path"] is None:
+        raise ValueError("You must specify the evaluation checkpoint path: checkpoint_path=...")
+    ckpt_path = pathlib.Path(flat.pop("checkpoint_path"))
+    cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not cfg_path.is_file():
+        raise FileNotFoundError(f"Archived run config not found at '{cfg_path}'")
+    with open(cfg_path) as fp:
+        cfg = dotdict(yaml.safe_load(fp))
+    # user overrides on top (fabric + float precision typically)
+    from sheeprl_tpu.config import deep_merge
+
+    deep_merge(cfg, dotdict(nest_dotted(flat)))
+    cfg.run_name = f"{os.path.basename(str(ckpt_path.parent.parent))}_evaluation"
+    cfg.checkpoint_path = str(ckpt_path)
+    # force single-device, strategy-free evaluation (reference cli.py:388-401)
+    cfg.fabric = dotdict(
+        {
+            "_target_": "sheeprl_tpu.parallel.runtime.Runtime",
+            "devices": 1,
+            "num_nodes": 1,
+            "strategy": "auto",
+            "accelerator": cfg.fabric.get("accelerator", "auto"),
+            "precision": cfg.fabric.get("precision", "32-true"),
+        }
+    )
+    cfg.env.num_envs = 1
+    check_configs_evaluation(cfg)
+    eval_algorithm(cfg)
+
+
+def registration(args: Optional[Sequence[str]] = None) -> None:
+    """Model-registry entrypoint ``sheeprl-registration``
+    (reference cli.py:408-450): publish checkpointed models to MLflow."""
+    overrides = list(args if args is not None else sys.argv[1:])
+    flat: Dict[str, Any] = {}
+    for ov in overrides:
+        key, _, value = ov.partition("=")
+        flat[key.lstrip("+")] = yaml.safe_load(value) if value != "" else None
+    ckpt = flat.pop("checkpoint_path", None)
+    if ckpt is None:
+        raise ValueError("You must specify the checkpoint path: checkpoint_path=...")
+    ckpt_path = pathlib.Path(ckpt)
+    cfg_path = ckpt_path.parent.parent / "config.yaml"
+    with open(cfg_path) as fp:
+        cfg = dotdict(yaml.safe_load(fp))
+    from sheeprl_tpu.config import deep_merge
+
+    deep_merge(cfg, dotdict(nest_dotted(flat)))
+    cfg.checkpoint_path = str(ckpt_path)
+    from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint
+
+    register_model_from_checkpoint(cfg)

@@ -39,6 +39,31 @@ CONFIG_DIR = Path(__file__).parent / "configs"
 _INTERP_RE = re.compile(r"\$\{([^${}]+)\}")
 
 
+class _Yaml12Loader(yaml.SafeLoader):
+    """SafeLoader with YAML-1.2 float semantics: PyYAML (YAML 1.1) parses
+    ``1e-4`` as a *string* because it requires a dot before the exponent;
+    Hydra/OmegaConf accept it as a float and the reference's configs rely on
+    that (e.g. ``eps: 1e-04`` in configs/algo/ppo.yaml)."""
+
+
+_Yaml12Loader.add_implicit_resolver(
+    "tag:yaml.org,2002:float",
+    re.compile(
+        r"""^(?:[-+]?(?:[0-9][0-9_]*)\.[0-9_]*(?:[eE][-+]?[0-9]+)?
+        |[-+]?(?:[0-9][0-9_]*)(?:[eE][-+]?[0-9]+)
+        |\.[0-9_]+(?:[eE][-+][0-9]+)?
+        |[-+]?\.(?:inf|Inf|INF)
+        |\.(?:nan|NaN|NAN))$""",
+        re.X,
+    ),
+    list("-+0123456789."),
+)
+
+
+def yaml_load(text: str) -> Any:
+    return yaml.load(text, Loader=_Yaml12Loader)
+
+
 class ConfigError(RuntimeError):
     pass
 
@@ -74,7 +99,7 @@ def _load_yaml(path: Path) -> Tuple[Dict[str, Any], bool]:
             break
         if stripped and not stripped.startswith("#"):
             break
-    data = yaml.safe_load(text) or {}
+    data = yaml_load(text) or {}
     if not isinstance(data, dict):
         raise ConfigError(f"Top-level YAML in {path} must be a mapping")
     return data, is_global
@@ -133,7 +158,9 @@ def _compose_group_file(group: str, option: str, dirs: Sequence[Path]) -> Dict[s
     return result
 
 
-def _parse_overrides(overrides: Sequence[str]) -> Tuple[Dict[str, str], Dict[str, Any]]:
+def _parse_overrides(
+    overrides: Sequence[str], dirs: Sequence[Path] = (CONFIG_DIR,)
+) -> Tuple[Dict[str, str], Dict[str, Any]]:
     """Split CLI overrides into group selections and dotted value overrides."""
     group_sel: Dict[str, str] = {}
     dotted: Dict[str, Any] = {}
@@ -142,8 +169,8 @@ def _parse_overrides(overrides: Sequence[str]) -> Tuple[Dict[str, str], Dict[str
             raise ConfigError(f"Override '{ov}' is not of the form key=value")
         key, _, value = ov.partition("=")
         key = key.lstrip("+~")
-        parsed = yaml.safe_load(value) if value != "" else None
-        if "." not in key and (CONFIG_DIR / key).is_dir():
+        parsed = yaml_load(value) if value != "" else None
+        if "." not in key and any((d / key).is_dir() for d in dirs):
             group_sel[key] = str(value)
         else:
             dotted[key] = parsed
@@ -248,7 +275,7 @@ def compose(
     root_data, _ = _load_yaml(root_path)
     root_defaults = root_data.pop("defaults", [])
 
-    group_sel, dotted = _parse_overrides(overrides)
+    group_sel, dotted = _parse_overrides(overrides, dirs)
 
     # Pass 1: figure out which option each group uses.
     selections: Dict[str, str] = {}
@@ -354,16 +381,20 @@ def instantiate(node: Mapping[str, Any] | Any, *args: Any, **kwargs: Any) -> Any
     target = node["_target_"]
     module_name, _, attr = target.rpartition(".")
     obj = getattr(importlib.import_module(module_name), attr)
+    def _inst(v: Any) -> Any:
+        if isinstance(v, Mapping):
+            if "_target_" in v:
+                return instantiate(v)
+            return {kk: _inst(vv) for kk, vv in v.items()}
+        if isinstance(v, list):
+            return [_inst(item) for item in v]
+        return v
+
     call_kwargs: Dict[str, Any] = {}
     for k, v in node.items():
         if k in ("_target_", "_partial_", "_convert_"):
             continue
-        if isinstance(v, Mapping) and "_target_" in v:
-            call_kwargs[k] = instantiate(v)
-        elif isinstance(v, list):
-            call_kwargs[k] = [instantiate(item) if isinstance(item, Mapping) and "_target_" in item else item for item in v]
-        else:
-            call_kwargs[k] = v
+        call_kwargs[k] = _inst(v)
     call_kwargs.update(kwargs)
     if node.get("_partial_", False):
         return functools.partial(obj, *args, **call_kwargs)

@@ -222,10 +222,17 @@ def make_env(
 
 def vectorized_env(env_fns, sync: bool = True) -> gym.vector.VectorEnv:
     """SyncVectorEnv or AsyncVectorEnv (one OS subprocess per env — the
-    reference's actor parallelism, utils/env.py + e.g. algos/ppo/ppo.py:137)."""
+    reference's actor parallelism, utils/env.py + e.g. algos/ppo/ppo.py:137).
+
+    ``SAME_STEP`` autoreset reproduces the gym-0.29 semantics the reference
+    was written against: on done the returned obs is the new episode's reset
+    obs and the terminal obs rides in ``infos["final_obs"]`` (needed for
+    truncation bootstrapping, reference algos/ppo/ppo.py:287-306).
+    """
+    mode = gym.vector.AutoresetMode.SAME_STEP
     if sync or len(env_fns) == 1:
-        return gym.vector.SyncVectorEnv(env_fns)
-    return gym.vector.AsyncVectorEnv(env_fns)
+        return gym.vector.SyncVectorEnv(env_fns, autoreset_mode=mode)
+    return gym.vector.AsyncVectorEnv(env_fns, autoreset_mode=mode)
 
 
 def get_dummy_env(id: str) -> gym.Env:

@@ -66,7 +66,18 @@ class Runtime:
         if num_nodes > 1 and not jax.process_count() > 1 and os.environ.get("JAX_COORDINATOR_ADDRESS"):
             jax.distributed.initialize()  # pragma: no cover - needs a pod
 
-        available = jax.devices()
+        if accelerator in ("auto", None):
+            available = jax.devices()
+        else:
+            # explicit backend: "cpu" | "gpu" | "tpu" (the axon TPU tunnel
+            # registers under its own platform name, so fall back to it)
+            try:
+                available = jax.devices(accelerator)
+            except RuntimeError:
+                if accelerator == "tpu":
+                    available = jax.devices("axon")
+                else:
+                    raise
         if devices in ("auto", -1, "-1"):
             n = len(available)
         else:

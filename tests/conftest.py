@@ -14,6 +14,13 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The image's sitecustomize registers the `axon` TPU tunnel and force-sets
+# jax_platforms programmatically, which beats the env var; override it back so
+# the suite runs on the virtual 8-device CPU platform.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
