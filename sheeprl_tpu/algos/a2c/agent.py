@@ -1,0 +1,28 @@
+"""A2C agent (reference /root/reference/sheeprl/algos/a2c/agent.py).
+
+The reference A2C agent is the PPO architecture restricted to vector
+observations (MLP encoder only); the flax module is shared with PPO — the
+restriction is enforced in ``build_agent``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import gymnasium
+
+from sheeprl_tpu.algos.ppo.agent import PPOAgent as A2CAgent  # noqa: F401
+from sheeprl_tpu.algos.ppo.agent import build_agent as _build_ppo_agent
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space: gymnasium.spaces.Dict,
+    agent_state: Optional[Dict[str, Any]] = None,
+):
+    if cfg.algo.cnn_keys.encoder:
+        raise ValueError("A2C only supports vector observations (algo.cnn_keys.encoder must be [])")
+    return _build_ppo_agent(runtime, actions_dim, is_continuous, cfg, obs_space, agent_state)

@@ -1,0 +1,27 @@
+"""A2C losses (reference /root/reference/sheeprl/algos/a2c/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    reduction = reduction.lower()
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction == "none":
+        return x
+    raise ValueError(f"Unrecognized reduction: {reduction}")
+
+
+def policy_loss(logprobs: jax.Array, advantages: jax.Array, reduction: str = "mean") -> jax.Array:
+    """Vanilla policy-gradient loss (reference loss.py:5-32)."""
+    return _reduce(-(logprobs * advantages), reduction)
+
+
+def value_loss(values: jax.Array, returns: jax.Array, reduction: str = "mean") -> jax.Array:
+    """MSE critic loss (reference loss.py:35-40)."""
+    return _reduce((values - returns) ** 2, reduction)

@@ -1,0 +1,13 @@
+"""A2C helper surface (reference /root/reference/sheeprl/algos/a2c/utils.py)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.ppo.utils import prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}

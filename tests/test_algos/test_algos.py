@@ -105,6 +105,21 @@ def test_ppo_vector_only():
     )
 
 
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_a2c(devices, env_id):
+    _run_cli(
+        "exp=a2c",
+        *COMMON,
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        f"env.id={env_id}",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=8",
+        "algo.mlp_keys.encoder=[state]",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
 def test_unknown_algorithm_raises():
     with pytest.raises(Exception):
         _run_cli("exp=ppo", "algo.name=not_a_real_algo", "env=dummy", "fabric.accelerator=cpu")
