@@ -1,0 +1,137 @@
+"""SAC agent — TPU-native re-design of
+/root/reference/sheeprl/algos/sac/agent.py:16-371.
+
+- ``SACActor``: squashed diagonal Gaussian with clamped log-std and
+  action-space rescaling (reference agent.py:57-142).
+- ``SACCritics``: the twin/ensemble Q network as **one vmapped module** — the
+  reference holds N separate MLPs in a ModuleList (agent.py:20-54,145-180);
+  stacking them into a leading ensemble axis turns N small matmuls into one
+  batched MXU matmul per layer.
+- ``log_alpha`` automatic entropy tuning lives as its own 1-element params
+  tree; the Polyak-averaged target critic is a second params pytree updated
+  with ``optax.incremental_update`` (reference ``qfs_target_ema``,
+  agent.py:204-233).
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.models.blocks import MLP
+
+LOG_STD_MAX = 2.0
+LOG_STD_MIN = -5.0
+
+
+class SACActor(nn.Module):
+    """Tanh-Gaussian actor (reference agent.py:57-142)."""
+
+    action_dim: int
+    hidden_size: int = 256
+    action_low: Sequence[float] | float = -1.0
+    action_high: Sequence[float] | float = 1.0
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), activation="relu")(obs)
+        mean = nn.Dense(self.action_dim)(x)
+        log_std = nn.Dense(self.action_dim)(x)
+        std = jnp.exp(jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+        return mean, std
+
+    def _scale(self) -> Tuple[jax.Array, jax.Array]:
+        low = jnp.asarray(self.action_low, jnp.float32)
+        high = jnp.asarray(self.action_high, jnp.float32)
+        return (high - low) / 2.0, (high + low) / 2.0
+
+    def sample_and_log_prob(self, obs: jax.Array, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """rsample + tanh change-of-variables log-prob (reference agent.py:109-142)."""
+        mean, std = self(obs)
+        scale, bias = self._scale()
+        eps = jax.random.normal(key, mean.shape)
+        x_t = mean + std * eps
+        y_t = jnp.tanh(x_t)
+        action = y_t * scale + bias
+        var = std**2
+        log_prob = -((x_t - mean) ** 2) / (2 * var) - jnp.log(std) - 0.5 * jnp.log(2 * jnp.pi)
+        log_prob = log_prob - jnp.log(scale * (1 - y_t**2) + 1e-6)
+        return action, jnp.sum(log_prob, axis=-1, keepdims=True)
+
+    def greedy_action(self, obs: jax.Array) -> jax.Array:
+        mean, _ = self(obs)
+        scale, bias = self._scale()
+        return jnp.tanh(mean) * scale + bias
+
+
+class _QNetwork(nn.Module):
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, actions: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, actions], axis=-1)
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), output_dim=1, activation="relu")(x)
+        return x
+
+
+class SACCritics(nn.Module):
+    """N Q-networks as one vmapped ensemble; output ``[..., N]``."""
+
+    num_critics: int = 2
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, actions: jax.Array) -> jax.Array:
+        vmapped = nn.vmap(
+            _QNetwork,
+            in_axes=None,
+            out_axes=-1,
+            axis_size=self.num_critics,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(hidden_size=self.hidden_size)
+        return vmapped(obs, actions)[..., 0, :]
+
+
+def build_agent(
+    runtime,
+    cfg,
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+):
+    """Create actor/critic modules + params trees (reference agent.py:236-371).
+
+    Returns ``(actor_def, critic_def, params)`` where params holds
+    ``{"actor", "critic", "target_critic", "log_alpha"}``.
+    """
+    act_dim = int(prod(action_space.shape))
+    obs_dim = int(sum(prod(obs_space[k].shape) for k in cfg.algo.mlp_keys.encoder))
+    actor_def = SACActor(
+        action_dim=act_dim,
+        hidden_size=cfg.algo.actor.hidden_size,
+        action_low=tuple(np.asarray(action_space.low, dtype=np.float32).reshape(-1).tolist()),
+        action_high=tuple(np.asarray(action_space.high, dtype=np.float32).reshape(-1).tolist()),
+    )
+    critic_def = SACCritics(num_critics=cfg.algo.critic.n, hidden_size=cfg.algo.critic.hidden_size)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(int(cfg.seed or 0)))
+    dummy_obs = jnp.zeros((1, obs_dim), jnp.float32)
+    dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+    actor_params = actor_def.init(k1, dummy_obs)
+    critic_params = critic_def.init(k2, dummy_obs, dummy_act)
+    params = {
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
+        "log_alpha": jnp.log(jnp.asarray([cfg.algo.alpha.alpha], jnp.float32)),
+    }
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    target_entropy = -act_dim  # reference sac.py:155: -prod(action shape)
+    return actor_def, critic_def, params, target_entropy

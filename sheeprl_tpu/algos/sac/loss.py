@@ -1,0 +1,23 @@
+"""SAC losses (reference /root/reference/sheeprl/algos/sac/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def critic_loss(qf_values: jax.Array, next_qf_value: jax.Array, num_critics: int) -> jax.Array:
+    """Sum of per-critic MSE against the shared soft target
+    (reference loss.py:9-18)."""
+    del num_critics  # derived from the trailing axis
+    return jnp.sum(jnp.mean((qf_values - next_qf_value) ** 2, axis=tuple(range(qf_values.ndim - 1))))
+
+
+def policy_loss(alpha: jax.Array, logprobs: jax.Array, min_qf_values: jax.Array) -> jax.Array:
+    """alpha*logpi - minQ (reference loss.py:21-24)."""
+    return jnp.mean(alpha * logprobs - min_qf_values)
+
+
+def entropy_loss(log_alpha: jax.Array, logprobs: jax.Array, target_entropy: float) -> jax.Array:
+    """Automatic entropy-coefficient loss (reference loss.py:27-30)."""
+    return jnp.mean(-log_alpha * (jax.lax.stop_gradient(logprobs) + target_entropy))

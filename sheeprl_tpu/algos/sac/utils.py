@@ -1,0 +1,42 @@
+"""SAC helper surface (reference /root/reference/sheeprl/algos/sac/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1) -> jax.Array:
+    """Concatenate vector keys into the flat observation the SAC nets consume
+    (reference utils.py:13-24)."""
+    arr = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1)
+    return jnp.asarray(arr)
+
+
+def test(actor_apply, actor_params, env, runtime, cfg, log_dir: str) -> float:
+    """One greedy episode (reference utils.py:27-51)."""
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=cfg.seed)
+    while not done:
+        flat_obs = prepare_obs(obs, mlp_keys=cfg.algo.mlp_keys.encoder)
+        action = actor_apply(actor_params, flat_obs, method="greedy_action")
+        obs, reward, terminated, truncated, _ = env.step(np.asarray(action).reshape(env.action_space.shape))
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    env.close()
+    return cumulative_rew

@@ -120,6 +120,39 @@ def test_a2c(devices, env_id):
     assert _checkpoint_paths(), "no checkpoint written"
 
 
+def test_sac(devices):
+    _run_cli(
+        "exp=sac",
+        *COMMON,
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "env.id=continuous_dummy",
+        "buffer.size=64",
+        "algo.learning_starts=0",
+        "algo.per_rank_batch_size=4",
+        "algo.mlp_keys.encoder=[state]",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
+def test_sac_sample_next_obs(devices):
+    _run_cli(
+        "exp=sac",
+        *COMMON,
+        "dry_run=False",
+        "algo.total_steps=8",
+        "algo.run_test=False",
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "env.id=continuous_dummy",
+        "buffer.size=64",
+        "buffer.sample_next_obs=True",
+        "algo.learning_starts=6",
+        "algo.per_rank_batch_size=4",
+        "algo.mlp_keys.encoder=[state]",
+    )
+
+
 def test_unknown_algorithm_raises():
     with pytest.raises(Exception):
         _run_cli("exp=ppo", "algo.name=not_a_real_algo", "env=dummy", "fabric.accelerator=cpu")
