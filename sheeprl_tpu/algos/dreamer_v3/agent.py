@@ -1,0 +1,779 @@
+"""DreamerV3 agent — TPU-native re-design of
+/root/reference/sheeprl/algos/dreamer_v3/agent.py:42-1236.
+
+Architecture parity with the reference (CNN/MLP encoders & decoders, the
+RSSM with unimix + straight-through discrete latents, two-hot reward/critic
+heads, Bernoulli continue head, scaled-normal/discrete actor, Hafner init),
+re-expressed functionally:
+
+- every model is a flax module over a params pytree; the "player" and
+  "target critic" are not module copies with tied weights (reference
+  agent.py:1190-1235) but simply *the same or EMA'd params values*;
+- convolutions run NHWC (XLA-native TPU layout); the CHW buffer convention is
+  transposed once inside the graph;
+- the T-step dynamic unroll and H-step imagination are `jax.lax.scan` bodies
+  built in the train step (../dreamer_v3/dreamer_v3.py), not Python loops;
+- stochastic states are kept flattened [..., stochastic*discrete] and
+  reshaped at the categorical boundaries.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.models.blocks import LayerNormGRUCell
+from sheeprl_tpu.ops.numerics import symlog
+
+# Hafner initializers (reference algos/dreamer_v3/utils.py:143-188)
+trunc_normal_init = nn.initializers.variance_scaling(1.0, "fan_avg", "truncated_normal")
+
+
+def uniform_init(scale: float):
+    if scale <= 0.0:
+        return nn.initializers.zeros
+    return nn.initializers.variance_scaling(scale, "fan_avg", "uniform")
+
+
+class DenseStack(nn.Module):
+    """[Dense(no bias) → LayerNorm(eps) → act] × layers
+    (the reference's MLP(…, bias=False, norm_layer=LayerNorm), agent.py:100-151)."""
+
+    units: int
+    layers: int
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for _ in range(self.layers):
+            x = nn.Dense(self.units, use_bias=False, kernel_init=trunc_normal_init)(x)
+            x = nn.LayerNorm(epsilon=self.eps)(x)
+            x = jax.nn.silu(x)
+        return x
+
+
+class CNNEncoderDV3(nn.Module):
+    """4-stage stride-2 conv encoder (reference agent.py:42-100).  Input is the
+    channel-concat of pixel keys in CHW; transposed to NHWC internally."""
+
+    keys: Sequence[str]
+    channels_multiplier: int
+    stages: int = 4
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-3)
+        lead = x.shape[:-3]
+        x = x.reshape((-1,) + x.shape[-3:])
+        x = jnp.transpose(x, (0, 2, 3, 1))  # CHW -> HWC
+        for i in range(self.stages):
+            x = nn.Conv(
+                (2**i) * self.channels_multiplier,
+                (4, 4),
+                strides=(2, 2),
+                padding=((1, 1), (1, 1)),
+                use_bias=False,
+                kernel_init=trunc_normal_init,
+            )(x)
+            x = nn.LayerNorm(epsilon=self.eps)(x)  # channel-last LN: native in NHWC
+            x = jax.nn.silu(x)
+        return x.reshape(lead + (-1,))
+
+
+class MLPEncoderDV3(nn.Module):
+    """Symlog-input dense encoder (reference agent.py:100-151)."""
+
+    keys: Sequence[str]
+    dense_units: int
+    mlp_layers: int
+    eps: float = 1e-3
+    symlog_inputs: bool = True
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([symlog(obs[k]) if self.symlog_inputs else obs[k] for k in self.keys], axis=-1)
+        return DenseStack(self.dense_units, self.mlp_layers, self.eps)(x)
+
+
+class CNNDecoderDV3(nn.Module):
+    """Inverse of the encoder (reference agent.py:155-226): Linear projection
+    to a 4x4 feature map, then stride-2 transposed convs back to image size.
+    Returns the concatenated CHW reconstruction (split per key by caller)."""
+
+    total_channels: int
+    channels_multiplier: int
+    image_size: Tuple[int, int]
+    stages: int = 4
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> jax.Array:
+        lead = latent.shape[:-1]
+        start = self.image_size[0] // (2**self.stages)
+        top_channels = (2 ** (self.stages - 1)) * self.channels_multiplier
+        x = nn.Dense(start * start * (2 ** (self.stages - 1)) * self.channels_multiplier, kernel_init=trunc_normal_init)(
+            latent
+        )
+        x = x.reshape((-1, start, start, top_channels))
+        for i in range(self.stages - 1):
+            x = nn.ConvTranspose(
+                (2 ** (self.stages - i - 2)) * self.channels_multiplier,
+                (4, 4),
+                strides=(2, 2),
+                padding="SAME",
+                use_bias=False,
+                kernel_init=trunc_normal_init,
+            )(x)
+            x = nn.LayerNorm(epsilon=self.eps)(x)
+            x = jax.nn.silu(x)
+        x = nn.ConvTranspose(
+            self.total_channels, (4, 4), strides=(2, 2), padding="SAME", kernel_init=uniform_init(1.0)
+        )(x)
+        x = jnp.transpose(x, (0, 3, 1, 2))  # HWC -> CHW
+        return x.reshape(lead + x.shape[1:])
+
+
+class MLPDecoderDV3(nn.Module):
+    """Dense decoder with one linear head per vector key (reference agent.py:229-280)."""
+
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    dense_units: int
+    mlp_layers: int
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        x = DenseStack(self.dense_units, self.mlp_layers, self.eps)(latent)
+        return {
+            k: nn.Dense(d, kernel_init=uniform_init(1.0))(x) for k, d in zip(self.keys, self.output_dims)
+        }
+
+
+class RecurrentModel(nn.Module):
+    """Dense projection + LayerNorm-GRU (reference agent.py:281-341)."""
+
+    recurrent_state_size: int
+    dense_units: int
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = DenseStack(self.dense_units, 1, self.eps)(x)
+        return LayerNormGRUCell(
+            hidden_size=self.recurrent_state_size, use_bias=False, layer_norm=True, norm_eps=self.eps
+        )(recurrent_state, feat)
+
+
+def _unimix(logits: jax.Array, discrete: int, unimix: float) -> jax.Array:
+    """1% uniform-mix on the per-variable categorical logits
+    (reference agent.py:437-449)."""
+    shape = logits.shape
+    logits = logits.reshape(shape[:-1] + (-1, discrete))
+    if unimix > 0.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        uniform = jnp.ones_like(probs) / discrete
+        probs = (1 - unimix) * probs + unimix * uniform
+        logits = jnp.log(probs)
+    return logits.reshape(shape)
+
+
+def compute_stochastic_state(logits: jax.Array, discrete: int, key: Optional[jax.Array], sample: bool = True):
+    """Straight-through sample of the [stoch, discrete] categorical block,
+    returned flattened (reference algos/dreamer_v2/agent.py compute_stochastic_state)."""
+    shape = logits.shape
+    logits = logits.reshape(shape[:-1] + (-1, discrete))
+    if sample:
+        idx = jax.random.categorical(key, logits, axis=-1)
+        hard = jax.nn.one_hot(idx, discrete, dtype=logits.dtype)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = hard + probs - jax.lax.stop_gradient(probs)  # straight-through
+    else:
+        idx = jnp.argmax(logits, axis=-1)
+        out = jax.nn.one_hot(idx, discrete, dtype=logits.dtype)
+    return out.reshape(shape)
+
+
+class RSSM(nn.Module):
+    """Recurrent State-Space Model (reference agent.py:344-498).
+
+    Stochastic states flow flattened ``[..., stochastic*discrete]``.
+    """
+
+    recurrent_state_size: int
+    stochastic_size: int
+    discrete_size: int
+    dense_units: int
+    hidden_size: int
+    embedded_obs_size: int
+    unimix: float = 0.01
+    eps: float = 1e-3
+    learnable_initial_recurrent_state: bool = True
+    decoupled: bool = False
+
+    def setup(self) -> None:
+        self.recurrent_model = RecurrentModel(
+            recurrent_state_size=self.recurrent_state_size, dense_units=self.dense_units, eps=self.eps
+        )
+        stoch_flat = self.stochastic_size * self.discrete_size
+        self.representation_model = _StochHead(self.hidden_size, stoch_flat, self.eps)
+        self.transition_model = _StochHead(self.hidden_size, stoch_flat, self.eps)
+        if self.learnable_initial_recurrent_state:
+            self.initial_recurrent_state = self.param(
+                "initial_recurrent_state", nn.initializers.zeros, (self.recurrent_state_size,)
+            )
+        else:
+            self.initial_recurrent_state = jnp.zeros((self.recurrent_state_size,))
+
+    def __call__(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
+        # init path: exercise every submodule
+        return self.dynamic(posterior, recurrent_state, action, embedded_obs, is_first, key)
+
+    def get_initial_states(self, batch_shape: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
+        h0 = jnp.tanh(self.initial_recurrent_state)
+        h0 = jnp.broadcast_to(h0, tuple(batch_shape) + h0.shape)
+        logits = self.transition_model(h0)
+        logits = _unimix(logits, self.discrete_size, self.unimix)
+        z0 = compute_stochastic_state(logits, self.discrete_size, None, sample=False)
+        return h0, z0
+
+    def _representation(self, recurrent_state, embedded_obs, key):
+        inp = (
+            embedded_obs
+            if self.decoupled
+            else jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
+        )
+        logits = _unimix(self.representation_model(inp), self.discrete_size, self.unimix)
+        return logits, compute_stochastic_state(logits, self.discrete_size, key)
+
+    def _transition(self, recurrent_out, key, sample_state: bool = True):
+        logits = _unimix(self.transition_model(recurrent_out), self.discrete_size, self.unimix)
+        return logits, compute_stochastic_state(logits, self.discrete_size, key, sample=sample_state)
+
+    def dynamic(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
+        """One step of dynamic learning (reference agent.py:396-435).
+        All states flattened; ``is_first`` resets to the learned initial state."""
+        k1, k2 = jax.random.split(key)
+        action = (1 - is_first) * action
+        initial_recurrent, initial_posterior = self.get_initial_states(recurrent_state.shape[:-1])
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * initial_recurrent
+        posterior = (1 - is_first) * posterior + is_first * initial_posterior
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], axis=-1), recurrent_state
+        )
+        prior_logits, prior = self._transition(recurrent_state, k1)
+        posterior_logits, posterior = self._representation(recurrent_state, embedded_obs, k2)
+        return recurrent_state, posterior, prior, posterior_logits, prior_logits
+
+    def imagination(self, prior, recurrent_state, actions, key):
+        """One-step latent imagination (reference agent.py:478-498)."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([prior, actions], axis=-1), recurrent_state
+        )
+        _, imagined_prior = self._transition(recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+
+class _StochHead(nn.Module):
+    """hidden dense stack + linear head to the stochastic logits, Hafner
+    uniform(1.0) head init (reference build_agent, agent.py:1178-1183)."""
+
+    hidden_size: int
+    out_size: int
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = DenseStack(self.hidden_size, 1, self.eps)(x)
+        return nn.Dense(self.out_size, kernel_init=uniform_init(1.0))(x)
+
+
+class PredictionHead(nn.Module):
+    """MLP + linear head used by reward (zero-init), continue (uniform 1.0)
+    and critic (zero-init) models (reference build_agent, agent.py:1100-1140)."""
+
+    dense_units: int
+    mlp_layers: int
+    out_dim: int
+    head_scale: float = 0.0
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = DenseStack(self.dense_units, self.mlp_layers, self.eps)(x)
+        return nn.Dense(self.out_dim, kernel_init=uniform_init(self.head_scale))(x)
+
+
+class WorldModel(nn.Module):
+    """Encoder + RSSM + decoders + reward + continue as ONE module/params tree
+    (the reference's `WorldModel` container, dreamer_v2/agent.py:707-732, keeps
+    them separate modules under one optimizer; one tree == one optimizer)."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_decoder_keys: Sequence[str]
+    mlp_decoder_keys: Sequence[str]
+    mlp_output_dims: Sequence[int]
+    cnn_input_channels: Sequence[int]
+    image_size: Tuple[int, int]
+    channels_multiplier: int
+    cnn_stages: int
+    encoder_dense_units: int
+    encoder_mlp_layers: int
+    decoder_dense_units: int
+    decoder_mlp_layers: int
+    recurrent_state_size: int
+    stochastic_size: int
+    discrete_size: int
+    rssm_dense_units: int
+    rssm_hidden_size: int
+    reward_dense_units: int
+    reward_mlp_layers: int
+    reward_bins: int
+    continue_dense_units: int
+    continue_mlp_layers: int
+    unimix: float = 0.01
+    eps: float = 1e-3
+    learnable_initial_recurrent_state: bool = True
+    decoupled_rssm: bool = False
+
+    def setup(self) -> None:
+        self.cnn_encoder = (
+            CNNEncoderDV3(
+                keys=tuple(self.cnn_keys),
+                channels_multiplier=self.channels_multiplier,
+                stages=self.cnn_stages,
+                eps=self.eps,
+            )
+            if self.cnn_keys
+            else None
+        )
+        self.mlp_encoder = (
+            MLPEncoderDV3(
+                keys=tuple(self.mlp_keys),
+                dense_units=self.encoder_dense_units,
+                mlp_layers=self.encoder_mlp_layers,
+                eps=self.eps,
+            )
+            if self.mlp_keys
+            else None
+        )
+        embedded = 0
+        if self.cnn_keys:
+            embedded += (2 ** (self.cnn_stages - 1)) * self.channels_multiplier * (
+                self.image_size[0] // (2**self.cnn_stages)
+            ) * (self.image_size[1] // (2**self.cnn_stages))
+        if self.mlp_keys:
+            embedded += self.encoder_dense_units
+        self.rssm = RSSM(
+            recurrent_state_size=self.recurrent_state_size,
+            stochastic_size=self.stochastic_size,
+            discrete_size=self.discrete_size,
+            dense_units=self.rssm_dense_units,
+            hidden_size=self.rssm_hidden_size,
+            embedded_obs_size=embedded,
+            unimix=self.unimix,
+            eps=self.eps,
+            learnable_initial_recurrent_state=self.learnable_initial_recurrent_state,
+            decoupled=self.decoupled_rssm,
+        )
+        self.cnn_decoder = (
+            CNNDecoderDV3(
+                total_channels=int(sum(self.cnn_input_channels)),
+                channels_multiplier=self.channels_multiplier,
+                image_size=tuple(self.image_size),
+                stages=self.cnn_stages,
+                eps=self.eps,
+            )
+            if self.cnn_decoder_keys
+            else None
+        )
+        self.mlp_decoder = (
+            MLPDecoderDV3(
+                keys=tuple(self.mlp_decoder_keys),
+                output_dims=tuple(self.mlp_output_dims),
+                dense_units=self.decoder_dense_units,
+                mlp_layers=self.decoder_mlp_layers,
+                eps=self.eps,
+            )
+            if self.mlp_decoder_keys
+            else None
+        )
+        self.reward_model = PredictionHead(
+            self.reward_dense_units, self.reward_mlp_layers, self.reward_bins, head_scale=0.0, eps=self.eps
+        )
+        self.continue_model = PredictionHead(
+            self.continue_dense_units, self.continue_mlp_layers, 1, head_scale=1.0, eps=self.eps
+        )
+
+    # -- init path ----------------------------------------------------------
+    def __call__(self, obs, action, is_first, key):
+        embedded = self.encode(obs)
+        batch_shape = action.shape[:-1]
+        stoch_flat = self.stochastic_size * self.discrete_size
+        posterior = jnp.zeros(batch_shape + (stoch_flat,))
+        recurrent = jnp.zeros(batch_shape + (self.recurrent_state_size,))
+        recurrent, posterior, prior, post_logits, prior_logits = self.rssm.dynamic(
+            posterior, recurrent, action, embedded, is_first, key
+        )
+        latent = jnp.concatenate([posterior, recurrent], axis=-1)
+        recon = self.decode(latent)
+        reward = self.reward_model(latent)
+        cont = self.continue_model(latent)
+        return recon, reward, cont
+
+    # -- public methods (used via apply(..., method=...)) -------------------
+    def encode(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        feats = []
+        if self.cnn_encoder is not None:
+            feats.append(self.cnn_encoder(obs))
+        if self.mlp_encoder is not None:
+            feats.append(self.mlp_encoder(obs))
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+    def decode(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_decoder is not None:
+            recon = self.cnn_decoder(latent)
+            start = 0
+            for k, c in zip(self.cnn_decoder_keys, self.cnn_input_channels):
+                out[k] = recon[..., start : start + c, :, :]
+                start += c
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(latent))
+        return out
+
+    def reward_logits(self, latent: jax.Array) -> jax.Array:
+        return self.reward_model(latent)
+
+    def continue_logits(self, latent: jax.Array) -> jax.Array:
+        return self.continue_model(latent)
+
+    def dynamic(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
+        return self.rssm.dynamic(posterior, recurrent_state, action, embedded_obs, is_first, key)
+
+    def imagination(self, prior, recurrent_state, actions, key):
+        return self.rssm.imagination(prior, recurrent_state, actions, key)
+
+    def initial_states(self, batch_shape: Sequence[int]):
+        return self.rssm.get_initial_states(batch_shape)
+
+    def representation(self, recurrent_state, embedded_obs, key):
+        return self.rssm._representation(recurrent_state, embedded_obs, key)
+
+    def recurrent_step(self, stochastic, actions, recurrent_state):
+        return self.rssm.recurrent_model(
+            jnp.concatenate([stochastic, actions], axis=-1), recurrent_state
+        )
+
+
+class Actor(nn.Module):
+    """DV3 actor (reference agent.py:694-845): MLP backbone + one head per
+    discrete sub-action (unimix + straight-through) or a single
+    (mean, std) head for continuous (`scaled_normal`/`tanh_normal`)."""
+
+    latent_state_size: int
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    distribution: str = "auto"
+    init_std: float = 2.0
+    min_std: float = 0.1
+    max_std: float = 1.0
+    dense_units: int = 1024
+    mlp_layers: int = 5
+    unimix: float = 0.01
+    action_clip: float = 1.0
+    eps: float = 1e-3
+
+    def setup(self) -> None:
+        dist = self.distribution.lower()
+        if dist not in ("auto", "normal", "tanh_normal", "discrete", "scaled_normal"):
+            raise ValueError(f"Invalid actor distribution: {dist}")
+        if dist == "auto":
+            dist = "scaled_normal" if self.is_continuous else "discrete"
+        self.dist = dist
+        self.model = DenseStack(self.dense_units, self.mlp_layers, self.eps)
+        if self.is_continuous:
+            self.heads = [nn.Dense(int(sum(self.actions_dim)) * 2, kernel_init=uniform_init(1.0))]
+        else:
+            self.heads = [nn.Dense(d, kernel_init=uniform_init(1.0)) for d in self.actions_dim]
+
+    def __call__(self, state: jax.Array) -> Sequence[jax.Array]:
+        """Return the raw head outputs (`pre_dist`)."""
+        x = self.model(state)
+        return [h(x) for h in self.heads]
+
+    def _continuous_dist_params(self, pre: jax.Array):
+        mean, std = jnp.split(pre, 2, axis=-1)
+        if self.dist == "tanh_normal":
+            mean = 5 * jnp.tanh(mean / 5)
+            std = jax.nn.softplus(std + self.init_std) + self.min_std
+        elif self.dist == "scaled_normal":
+            std = (self.max_std - self.min_std) * jax.nn.sigmoid(std + self.init_std) + self.min_std
+            mean = jnp.tanh(mean)
+        return mean, std
+
+    def act(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False) -> jax.Array:
+        """Sample (or take the mode of) the actions, concatenated over heads."""
+        pre_dist = self(state)
+        if self.is_continuous:
+            mean, std = self._continuous_dist_params(pre_dist[0])
+            if greedy:
+                # the reference draws 100 samples and keeps the most likely
+                # (agent.py:817-821); the mode of the (tanh-)normal is cheaper
+                # and deterministic
+                actions = mean
+            else:
+                actions = mean + std * jax.random.normal(key, mean.shape)
+            if self.dist == "tanh_normal":
+                actions = jnp.tanh(actions)
+            if self.action_clip > 0.0:
+                clip = jnp.full_like(actions, self.action_clip)
+                actions = actions * jax.lax.stop_gradient(clip / jnp.maximum(clip, jnp.abs(actions)))
+            return actions
+        outs = []
+        for i, logits in enumerate(pre_dist):
+            logits = _unimix(logits, logits.shape[-1], self.unimix)
+            if greedy:
+                idx = jnp.argmax(logits, axis=-1)
+                one_hot = jax.nn.one_hot(idx, logits.shape[-1], dtype=logits.dtype)
+            else:
+                sub_key = jax.random.fold_in(key, i)
+                idx = jax.random.categorical(sub_key, logits, axis=-1)
+                hard = jax.nn.one_hot(idx, logits.shape[-1], dtype=logits.dtype)
+                probs = jax.nn.softmax(logits, axis=-1)
+                one_hot = hard + probs - jax.lax.stop_gradient(probs)
+            outs.append(one_hot)
+        return jnp.concatenate(outs, axis=-1)
+
+    def log_prob_entropy(self, state: jax.Array, actions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Log-prob of given (concatenated) actions + policy entropy, both
+        ``[..., 1]`` (reference train, dreamer_v3.py:280-297)."""
+        pre_dist = self(state)
+        if self.is_continuous:
+            mean, std = self._continuous_dist_params(pre_dist[0])
+            if self.dist == "tanh_normal":
+                from sheeprl_tpu.ops.numerics import safeatanh
+
+                x = safeatanh(actions, 1e-6)
+                var = std**2
+                lp = -((x - mean) ** 2) / (2 * var) - jnp.log(std) - 0.5 * jnp.log(2 * jnp.pi)
+                lp = lp - jnp.log1p(-(actions**2) + 1e-6)
+                log_prob = jnp.sum(lp, axis=-1, keepdims=True)
+                ent = -log_prob  # no closed form for tanh-normal entropy
+                return log_prob, ent
+            var = std**2
+            lp = -((actions - mean) ** 2) / (2 * var) - jnp.log(std) - 0.5 * jnp.log(2 * jnp.pi)
+            log_prob = jnp.sum(lp, axis=-1, keepdims=True)
+            ent = jnp.sum(0.5 + 0.5 * jnp.log(2 * jnp.pi) + jnp.log(std), axis=-1, keepdims=True)
+            return log_prob, ent
+        log_probs = []
+        entropies = []
+        start = 0
+        for i, logits in enumerate(pre_dist):
+            d = logits.shape[-1]
+            logits = _unimix(logits, d, self.unimix)
+            logits = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+            act = actions[..., start : start + d]
+            start += d
+            log_probs.append(jnp.sum(act * logits, axis=-1, keepdims=True))
+            p = jnp.exp(logits)
+            entropies.append(-jnp.sum(p * logits, axis=-1, keepdims=True))
+        return (
+            sum(log_probs),
+            sum(entropies),
+        )
+
+
+class Critic(nn.Module):
+    """Two-hot critic (reference build_agent, agent.py:1155-1175): MLP +
+    zero-initialized bins head."""
+
+    dense_units: int
+    mlp_layers: int
+    bins: int = 255
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = DenseStack(self.dense_units, self.mlp_layers, self.eps)(x)
+        return nn.Dense(self.bins, kernel_init=uniform_init(0.0))(x)
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+    target_critic_state: Optional[Dict[str, Any]] = None,
+):
+    """Create module definitions + params (reference agent.py:935-1235).
+
+    Returns ``(world_model_def, actor_def, critic_def, params)`` with params =
+    {"world_model", "actor", "critic", "target_critic"}.
+    """
+    wm_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    eps = float(cfg.algo.mlp_layer_norm.kw.get("eps", 1e-3)) if cfg.algo.get("mlp_layer_norm") else 1e-3
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_decoder_keys = list(cfg.algo.cnn_keys.decoder)
+    mlp_decoder_keys = list(cfg.algo.mlp_keys.decoder)
+    image_size = tuple(obs_space[cnn_keys[0]].shape[-2:]) if cnn_keys else (64, 64)
+    cnn_stages = int(np.log2(cfg.env.screen_size) - np.log2(4)) if cnn_keys else 4
+    recurrent_state_size = wm_cfg.recurrent_model.recurrent_state_size
+    stochastic_size = wm_cfg.stochastic_size
+    discrete_size = wm_cfg.discrete_size
+    latent_state_size = stochastic_size * discrete_size + recurrent_state_size
+
+    world_model_def = WorldModel(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        cnn_decoder_keys=tuple(cnn_decoder_keys),
+        mlp_decoder_keys=tuple(mlp_decoder_keys),
+        mlp_output_dims=tuple(int(prod(obs_space[k].shape)) for k in mlp_decoder_keys),
+        cnn_input_channels=tuple(int(prod(obs_space[k].shape[:-2])) for k in cnn_decoder_keys),
+        image_size=image_size,
+        channels_multiplier=wm_cfg.encoder.cnn_channels_multiplier,
+        cnn_stages=cnn_stages,
+        encoder_dense_units=wm_cfg.encoder.dense_units,
+        encoder_mlp_layers=wm_cfg.encoder.mlp_layers,
+        decoder_dense_units=wm_cfg.observation_model.dense_units,
+        decoder_mlp_layers=wm_cfg.observation_model.mlp_layers,
+        recurrent_state_size=recurrent_state_size,
+        stochastic_size=stochastic_size,
+        discrete_size=discrete_size,
+        rssm_dense_units=wm_cfg.recurrent_model.dense_units,
+        rssm_hidden_size=wm_cfg.representation_model.hidden_size,
+        reward_dense_units=wm_cfg.reward_model.dense_units,
+        reward_mlp_layers=wm_cfg.reward_model.mlp_layers,
+        reward_bins=wm_cfg.reward_model.bins,
+        continue_dense_units=wm_cfg.discount_model.dense_units,
+        continue_mlp_layers=wm_cfg.discount_model.mlp_layers,
+        unimix=cfg.algo.unimix,
+        eps=eps,
+        learnable_initial_recurrent_state=wm_cfg.learnable_initial_recurrent_state,
+        decoupled_rssm=wm_cfg.decoupled_rssm,
+    )
+    actor_def = Actor(
+        latent_state_size=latent_state_size,
+        actions_dim=tuple(int(a) for a in actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.type,
+        init_std=actor_cfg.init_std,
+        min_std=actor_cfg.min_std,
+        max_std=actor_cfg.get("max_std", 1.0),
+        dense_units=actor_cfg.dense_units,
+        mlp_layers=actor_cfg.mlp_layers,
+        unimix=cfg.algo.unimix,
+        action_clip=actor_cfg.action_clip,
+        eps=eps,
+    )
+    critic_def = Critic(
+        dense_units=critic_cfg.dense_units, mlp_layers=critic_cfg.mlp_layers, bins=critic_cfg.bins, eps=eps
+    )
+
+    key = jax.random.PRNGKey(int(cfg.seed or 0))
+    k_wm, k_actor, k_critic, k_call = jax.random.split(key, 4)
+    n_envs = 1
+    sample_obs: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        sample_obs[k] = jnp.zeros((n_envs,) + tuple(obs_space[k].shape), jnp.float32)
+    for k in mlp_keys:
+        sample_obs[k] = jnp.zeros((n_envs, int(prod(obs_space[k].shape))), jnp.float32)
+    sample_action = jnp.zeros((n_envs, int(sum(actions_dim))), jnp.float32)
+    sample_is_first = jnp.ones((n_envs, 1), jnp.float32)
+    wm_params = world_model_def.init(k_wm, sample_obs, sample_action, sample_is_first, k_call)
+    sample_latent = jnp.zeros((n_envs, latent_state_size), jnp.float32)
+    actor_params = actor_def.init(k_actor, sample_latent)
+    critic_params = critic_def.init(k_critic, sample_latent)
+    params = {
+        "world_model": wm_params,
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
+    }
+    if world_model_state is not None:
+        params["world_model"] = jax.tree_util.tree_map(jnp.asarray, world_model_state)
+    if actor_state is not None:
+        params["actor"] = jax.tree_util.tree_map(jnp.asarray, actor_state)
+    if critic_state is not None:
+        params["critic"] = jax.tree_util.tree_map(jnp.asarray, critic_state)
+    if target_critic_state is not None:
+        params["target_critic"] = jax.tree_util.tree_map(jnp.asarray, target_critic_state)
+    return world_model_def, actor_def, critic_def, params
+
+
+class PlayerDV3:
+    """Stateful env-interaction wrapper (reference agent.py:596-691).
+
+    Holds per-env recurrent/stochastic/action state as device arrays and
+    steps them with one jitted graph per call; resets are mask-based (static
+    shapes, no host round-trip per reset).
+    """
+
+    def __init__(self, world_model_def: WorldModel, actor_def: Actor, actions_dim, num_envs: int):
+        self.world_model_def = world_model_def
+        self.actor_def = actor_def
+        self.actions_dim = actions_dim
+        self.num_envs = num_envs
+        self.state = None
+
+        wm = world_model_def
+
+        def _init_state(wm_params, n):
+            h0, z0 = world_model_def.apply(wm_params, (n,), method="initial_states")
+            return {
+                "recurrent": h0,
+                "stochastic": z0,
+                "actions": jnp.zeros((n, int(sum(actions_dim))), jnp.float32),
+            }
+
+        def _reset_masked(wm_params, state, reset_mask):
+            init = _init_state(wm_params, state["recurrent"].shape[0])
+            return jax.tree_util.tree_map(
+                lambda i, s: reset_mask * i + (1 - reset_mask) * s, init, state
+            )
+
+        def _step(wm_params, actor_params, state, obs, key, greedy):
+            k1, k2 = jax.random.split(key)
+            embedded = wm.apply(wm_params, obs, method="encode")
+            recurrent = wm.apply(
+                wm_params, state["stochastic"], state["actions"], state["recurrent"], method="recurrent_step"
+            )
+            if wm.decoupled_rssm:
+                _, stochastic = wm.apply(wm_params, None, embedded, k1, method="representation")
+            else:
+                _, stochastic = wm.apply(wm_params, recurrent, embedded, k1, method="representation")
+            latent = jnp.concatenate([stochastic, recurrent], axis=-1)
+            actions = actor_def.apply(actor_params, latent, k2, greedy, method="act")
+            new_state = {"recurrent": recurrent, "stochastic": stochastic, "actions": actions}
+            return actions, new_state
+
+        self._init_state = jax.jit(_init_state, static_argnums=(1,))
+        self._reset_masked = jax.jit(_reset_masked)
+        self._step = jax.jit(_step, static_argnums=(5,))
+
+    def init_states(self, wm_params, reset_mask: Optional[np.ndarray] = None) -> None:
+        """Full or masked state reset (reference agent.py:644-659).
+        ``reset_mask`` is ``[num_envs, 1]`` float (1 = reset that env)."""
+        if self.state is None or reset_mask is None:
+            self.state = self._init_state(wm_params, self.num_envs)
+        else:
+            self.state = self._reset_masked(wm_params, self.state, jnp.asarray(reset_mask, jnp.float32))
+
+    def get_actions(self, wm_params, actor_params, obs, key, greedy: bool = False) -> jax.Array:
+        actions, self.state = self._step(wm_params, actor_params, self.state, obs, key, greedy)
+        return actions

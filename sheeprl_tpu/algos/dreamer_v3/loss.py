@@ -1,0 +1,67 @@
+"""DreamerV3 world-model loss (reference /root/reference/sheeprl/algos/dreamer_v3/loss.py:9-96).
+
+Pure function over arrays; KL balancing (0.5 dynamic / 0.1 representation)
+with free nats, observation/reward/continue log-probs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.ops.distributions import (
+    Bernoulli,
+    MSEDistribution,
+    SymlogDistribution,
+    TwoHotEncodingDistribution,
+    kl_categorical,
+)
+
+
+def reconstruction_loss(
+    po: Dict[str, object],
+    observations: Dict[str, jax.Array],
+    pr: TwoHotEncodingDistribution,
+    rewards: jax.Array,
+    priors_logits: jax.Array,
+    posteriors_logits: jax.Array,
+    kl_dynamic: float = 0.5,
+    kl_representation: float = 0.1,
+    kl_free_nats: float = 1.0,
+    kl_regularizer: float = 1.0,
+    pc: Optional[Bernoulli] = None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, ...]:
+    """priors/posteriors logits are ``[T, B, stoch, discrete]``."""
+    if len(po) == 0:
+        observation_loss = jnp.zeros_like(rewards[..., 0])
+    else:
+        observation_loss = -sum(po[k].log_prob(observations[k]) for k in po.keys())
+    reward_loss = -pr.log_prob(rewards)
+    # KL balancing (reference loss.py:70-83)
+    dyn_loss = kl = kl_categorical(
+        jax.lax.stop_gradient(posteriors_logits), priors_logits, event_dims=1
+    )
+    free_nats = jnp.full_like(dyn_loss, kl_free_nats)
+    dyn_loss = kl_dynamic * jnp.maximum(dyn_loss, free_nats)
+    repr_loss = kl_categorical(
+        posteriors_logits, jax.lax.stop_gradient(priors_logits), event_dims=1
+    )
+    repr_loss = kl_representation * jnp.maximum(repr_loss, free_nats)
+    kl_loss = dyn_loss + repr_loss
+    if pc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -pc.log_prob(continue_targets)
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = jnp.mean(kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss)
+    return (
+        rec_loss,
+        jnp.mean(kl),
+        jnp.mean(kl_loss),
+        jnp.mean(reward_loss),
+        jnp.mean(observation_loss),
+        jnp.mean(continue_loss),
+    )

@@ -1,0 +1,110 @@
+"""DreamerV3 helpers (reference /root/reference/sheeprl/algos/dreamer_v3/utils.py).
+
+``Moments`` is a pure-functional EMA of return percentiles: carried as a tiny
+state pytree updated inside the jitted train step.  The reference gathers
+values across ranks via ``fabric.all_gather`` before the quantile
+(utils.py:56-64); under single-controller GSPMD the quantile over the
+batch-sharded array already induces the cross-device collective.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
+
+
+def init_moments_state() -> Dict[str, jax.Array]:
+    return {"low": jnp.zeros(()), "high": jnp.zeros(())}
+
+
+def update_moments(
+    state: Dict[str, jax.Array],
+    x: jax.Array,
+    decay: float = 0.99,
+    max_: float = 1.0,
+    percentile_low: float = 0.05,
+    percentile_high: float = 0.95,
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Return (offset, invscale, new_state) (reference Moments.forward,
+    utils.py:56-64)."""
+    x = jax.lax.stop_gradient(x).astype(jnp.float32)
+    low = jnp.quantile(x, percentile_low)
+    high = jnp.quantile(x, percentile_high)
+    new_low = decay * state["low"] + (1 - decay) * low
+    new_high = decay * state["high"] + (1 - decay) * high
+    invscale = jnp.maximum(1.0 / max_, new_high - new_low)
+    return new_low, invscale, {"low": new_low, "high": new_high}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
+) -> Dict[str, jax.Array]:
+    """Host obs → device arrays ``[num_envs, ...]``; pixels scaled to
+    [-0.5, 0.5] (reference utils.py:80-92)."""
+    out: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        v = np.asarray(obs[k])
+        out[k] = jnp.asarray(v, jnp.float32).reshape(num_envs, -1, *v.shape[-2:]) / 255.0 - 0.5
+    for k in mlp_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k]), jnp.float32).reshape(num_envs, -1)
+    return out
+
+
+def test(player, wm_params, actor_params, runtime, cfg, log_dir: str, test_name: str = "", greedy: bool = True):
+    """One test episode (reference utils.py:95-140)."""
+    from sheeprl_tpu.envs.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    saved_num_envs = player.num_envs
+    player.num_envs = 1
+    player.state = None
+    player.init_states(wm_params)
+    key = jax.random.PRNGKey(cfg.seed or 0)
+    step = 0
+    while not done:
+        key, sub = jax.random.split(key)
+        torch_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, mlp_keys=cfg.algo.mlp_keys.encoder)
+        actions = np.asarray(player.get_actions(wm_params, actor_params, torch_obs, sub, greedy=greedy))
+        if player.actor_def.is_continuous:
+            real_actions = actions.reshape(env.action_space.shape)
+        else:
+            # one-hot concat -> per-head argmax indices
+            idxs = []
+            start = 0
+            for d in player.actions_dim:
+                idxs.append(np.argmax(actions[..., start : start + d], axis=-1))
+                start += d
+            real_actions = np.stack(idxs, axis=-1).reshape(env.action_space.shape)
+        obs, reward, terminated, truncated, _ = env.step(real_actions)
+        done = bool(terminated or truncated or cfg.dry_run)
+        cumulative_rew += float(reward)
+        step += 1
+    env.close()
+    player.num_envs = saved_num_envs
+    player.state = None
+    return cumulative_rew

@@ -153,6 +153,57 @@ def test_sac_sample_next_obs(devices):
     )
 
 
+DV3_TINY = [
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=1",
+    "algo.learning_starts=0",
+    "algo.replay_ratio=1",
+    "algo.horizon=8",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.cnn_keys.decoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.mlp_keys.decoder=[state]",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_dreamer_v3(devices, env_id):
+    _run_cli(
+        "exp=dreamer_v3",
+        *COMMON,
+        *DV3_TINY,
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "env=dummy",
+        f"env.id={env_id}",
+        "buffer.size=8",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
+def test_dreamer_v3_resume(devices):
+    args = [
+        "exp=dreamer_v3",
+        *COMMON,
+        *DV3_TINY,
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "buffer.size=8",
+    ]
+    _run_cli(*args)
+    ckpts = _checkpoint_paths()
+    assert ckpts
+    _run_cli(*args, f"checkpoint.resume_from={ckpts[-1]}")
+
+
 def test_unknown_algorithm_raises():
     with pytest.raises(Exception):
         _run_cli("exp=ppo", "algo.name=not_a_real_algo", "env=dummy", "fabric.accelerator=cpu")
