@@ -1,0 +1,94 @@
+"""DroQ agent (reference /root/reference/sheeprl/algos/droq/agent.py:20-276).
+
+DroQ = SAC with Dropout+LayerNorm critics (https://arxiv.org/abs/2110.02034)
+and a high replay ratio.  The critic ensemble is one vmapped module (N small
+MLPs → one batched MXU matmul per layer); dropout uses flax's rng collection.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.algos.sac.agent import SACActor
+
+
+class _DroQQNetwork(nn.Module):
+    hidden_size: int = 256
+    dropout: float = 0.01
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, actions: jax.Array, deterministic: bool = False) -> jax.Array:
+        x = jnp.concatenate([obs, actions], axis=-1)
+        for _ in range(2):
+            x = nn.Dense(self.hidden_size)(x)
+            if self.dropout > 0:
+                x = nn.Dropout(rate=self.dropout, deterministic=deterministic)(x)
+            x = nn.LayerNorm()(x)
+            x = jax.nn.relu(x)
+        return nn.Dense(1)(x)
+
+
+class DroQCritics(nn.Module):
+    """Vmapped ensemble of DroQ Q-networks, output ``[..., N]``."""
+
+    num_critics: int = 2
+    hidden_size: int = 256
+    dropout: float = 0.01
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, actions: jax.Array, deterministic: bool = False) -> jax.Array:
+        vmapped = nn.vmap(
+            _DroQQNetwork,
+            in_axes=(None, None, None),
+            out_axes=-1,
+            axis_size=self.num_critics,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )(hidden_size=self.hidden_size, dropout=self.dropout)
+        return vmapped(obs, actions, deterministic)[..., 0, :]
+
+
+def build_agent(
+    runtime,
+    cfg,
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+):
+    """Returns ``(actor_def, critic_def, params, target_entropy)``
+    (reference agent.py:212-276)."""
+    act_dim = int(prod(action_space.shape))
+    obs_dim = int(sum(prod(obs_space[k].shape) for k in cfg.algo.mlp_keys.encoder))
+    actor_def = SACActor(
+        action_dim=act_dim,
+        hidden_size=cfg.algo.actor.hidden_size,
+        action_low=tuple(np.asarray(action_space.low, dtype=np.float32).reshape(-1).tolist()),
+        action_high=tuple(np.asarray(action_space.high, dtype=np.float32).reshape(-1).tolist()),
+    )
+    critic_def = DroQCritics(
+        num_critics=cfg.algo.critic.n,
+        hidden_size=cfg.algo.critic.hidden_size,
+        dropout=cfg.algo.critic.dropout,
+    )
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(int(cfg.seed or 0)), 3)
+    dummy_obs = jnp.zeros((1, obs_dim), jnp.float32)
+    dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+    actor_params = actor_def.init(k1, dummy_obs)
+    critic_params = critic_def.init({"params": k2, "dropout": k3}, dummy_obs, dummy_act)
+    params = {
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
+        "log_alpha": jnp.log(jnp.asarray([cfg.algo.alpha.alpha], jnp.float32)),
+    }
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    target_entropy = -act_dim
+    return actor_def, critic_def, params, target_entropy

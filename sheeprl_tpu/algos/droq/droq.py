@@ -1,0 +1,309 @@
+"""DroQ training loop — TPU-native re-design of
+/root/reference/sheeprl/algos/droq/droq.py:30-436.
+
+Differences from SAC (reference droq.py:60-140):
+- dropout critics with per-gradient-step EMA of each target network;
+- the actor/alpha update uses a separate minibatch and averages (not mins)
+  the ensemble Q-values;
+- high replay ratio (20 gradient steps per policy step by default).
+
+The reference updates each of the N critics sequentially against the same
+soft target; with one shared optimizer this equals a joint update on the
+summed per-critic MSE, so here all critics update in one vmapped step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.droq.agent import build_agent
+from sheeprl_tpu.algos.droq.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
+from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: float):
+    tau = cfg.algo.tau
+    gamma = cfg.algo.gamma
+
+    def one_step(carry, inp):
+        params, opt_states = carry
+        batch, actor_batch, key = inp
+        k_next, k_drop, k_actor, k_drop2 = jax.random.split(key, 4)
+
+        # --- critic update (reference droq.py:95-120) ---------------------
+        next_actions, next_logprobs = actor_def.apply(
+            params["actor"], batch["next_observations"], k_next, method="sample_and_log_prob"
+        )
+        next_q = critic_def.apply(
+            params["target_critic"], batch["next_observations"], next_actions, True
+        )
+        min_next_q = jnp.min(next_q, axis=-1, keepdims=True)
+        alpha = jnp.exp(params["log_alpha"])
+        next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * (
+            min_next_q - alpha * next_logprobs
+        )
+        next_qf_value = jax.lax.stop_gradient(next_qf_value)
+
+        def qf_loss_fn(critic_params):
+            qf_values = critic_def.apply(
+                critic_params,
+                batch["observations"],
+                batch["actions"],
+                False,
+                rngs={"dropout": k_drop},
+            )
+            return jnp.sum(jnp.mean((qf_values - next_qf_value) ** 2, axis=tuple(range(qf_values.ndim - 1))))
+
+        qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
+        updates, opt_states["critic"] = optimizers["critic"].update(
+            qf_grads, opt_states["critic"], params["critic"]
+        )
+        params["critic"] = optax.apply_updates(params["critic"], updates)
+        params["target_critic"] = optax.incremental_update(params["critic"], params["target_critic"], tau)
+
+        # --- actor update on its own batch (reference droq.py:122-131) ----
+        def actor_loss_fn(actor_params):
+            actions, logprobs = actor_def.apply(
+                actor_params, actor_batch["observations"], k_actor, method="sample_and_log_prob"
+            )
+            q = critic_def.apply(
+                params["critic"], actor_batch["observations"], actions, False, rngs={"dropout": k_drop2}
+            )
+            mean_q = jnp.mean(q, axis=-1, keepdims=True)
+            alpha = jnp.exp(params["log_alpha"])
+            return policy_loss(alpha, logprobs, mean_q), logprobs
+
+        (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
+        updates, opt_states["actor"] = optimizers["actor"].update(
+            actor_grads, opt_states["actor"], params["actor"]
+        )
+        params["actor"] = optax.apply_updates(params["actor"], updates)
+
+        # --- alpha update (reference droq.py:133-139) ---------------------
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, logprobs, target_entropy)
+
+        alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"])
+        updates, opt_states["alpha"] = optimizers["alpha"].update(
+            alpha_grads, opt_states["alpha"], params["log_alpha"]
+        )
+        params["log_alpha"] = optax.apply_updates(params["log_alpha"], updates)
+
+        return (params, opt_states), jnp.stack([qf_l, actor_l, alpha_l])
+
+    def update(params, opt_states, data, actor_data, keys):
+        (params, opt_states), losses = jax.lax.scan(one_step, (params, opt_states), (data, actor_data, keys))
+        return params, opt_states, jnp.mean(losses, axis=0)
+
+    return jax.jit(update, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg):
+    world_size = runtime.world_size
+    num_envs = cfg.env.num_envs
+
+    rng_key = runtime.seed_everything(cfg.seed)
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+    if cfg.metric.log_level == 0:
+        aggregator.disabled = True
+    timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+
+    envs = vectorized_env(
+        [make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i) for i in range(num_envs)],
+        sync=cfg.env.sync_env,
+    )
+    observation_space = envs.single_observation_space
+    action_space = envs.single_action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("DroQ supports only continuous (Box) action spaces")
+    mlp_keys = cfg.algo.mlp_keys.encoder
+
+    state = runtime.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    actor_def, critic_def, params, target_entropy = build_agent(
+        runtime, cfg, observation_space, action_space, state["agent"] if state else None
+    )
+    optimizers = {
+        "actor": instantiate(cfg.algo.actor.optimizer),
+        "critic": instantiate(cfg.algo.critic.optimizer),
+        "alpha": instantiate(cfg.algo.alpha.optimizer),
+    }
+    opt_states = {
+        "actor": optimizers["actor"].init(params["actor"]),
+        "critic": optimizers["critic"].init(params["critic"]),
+        "alpha": optimizers["alpha"].init(params["log_alpha"]),
+    }
+    if state and "opt_states" in state:
+        opt_states = jax.tree_util.tree_map(
+            lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
+            opt_states,
+            state["opt_states"],
+        )
+
+    train_step = make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy)
+
+    @jax.jit
+    def policy_step(actor_params, obs, key):
+        actions, _ = actor_def.apply(actor_params, obs, key, method="sample_and_log_prob")
+        return actions
+
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer"),
+        obs_keys=("observations",),
+    )
+    if state and "rb" in state and state["rb"] is not None:
+        rb.load_state_dict(state["rb"])
+
+    start_iter = (state["iter_num"] if state else 0) + 1
+    policy_step_count = state["policy_step"] if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if cfg.checkpoint.resume_from:
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
+
+    batch_size = cfg.algo.per_rank_batch_size
+    obs, _ = envs.reset(seed=cfg.seed)
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step_count += policy_steps_per_iter
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                rng_key, step_key = jax.random.split(rng_key)
+                flat_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
+                actions = np.asarray(policy_step(params["actor"], flat_obs, step_key))
+            next_obs, rewards, terminated, truncated, info = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, -1)
+
+        if "final_info" in info and "episode" in info["final_info"]:
+            ep = info["final_info"]["episode"]
+            mask = ep.get("_r", info["final_info"].get("_episode"))
+            if mask is not None and np.any(mask):
+                for r, l in zip(ep["r"][mask], ep["l"][mask]):
+                    aggregator.update("Rewards/rew_avg", float(r))
+                    aggregator.update("Game/ep_len_avg", float(l))
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
+        if "final_obs" in info:
+            for idx, final_obs in enumerate(info["final_obs"]):
+                if final_obs is not None:
+                    for k in mlp_keys:
+                        real_next_obs[k][idx] = np.asarray(final_obs[k])
+
+        step_data: Dict[str, np.ndarray] = {}
+        step_data["observations"] = np.concatenate(
+            [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+        )[np.newaxis]
+        step_data["next_observations"] = np.concatenate(
+            [real_next_obs[k].astype(np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+        )[np.newaxis]
+        step_data["actions"] = actions.reshape(1, num_envs, -1)
+        step_data["rewards"] = rewards[np.newaxis]
+        step_data["terminated"] = np.asarray(terminated).reshape(1, num_envs, -1).astype(np.float32)
+        step_data["truncated"] = np.asarray(truncated).reshape(1, num_envs, -1).astype(np.float32)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        obs = next_obs
+
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step_count - prefill_steps * policy_steps_per_iter)
+            if cfg.dry_run:
+                per_rank_gradient_steps = 1
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    G = per_rank_gradient_steps
+                    sample = rb.sample(batch_size=batch_size * world_size, n_samples=G)
+                    actor_sample = rb.sample(batch_size=batch_size * world_size, n_samples=G)
+                    data = {
+                        k: jnp.asarray(np.asarray(v), jnp.float32)
+                        for k, v in sample.items()
+                        if k in ("observations", "next_observations", "actions", "rewards", "terminated")
+                    }
+                    actor_data = {"observations": jnp.asarray(np.asarray(actor_sample["observations"]), jnp.float32)}
+                    rng_key, scan_key = jax.random.split(rng_key)
+                    keys = jax.random.split(scan_key, G)
+                    params, opt_states, losses = train_step(params, opt_states, data, actor_data, keys)
+                    losses = np.asarray(losses)
+                aggregator.update("Loss/value_loss", float(losses[0]))
+                aggregator.update("Loss/policy_loss", float(losses[1]))
+                aggregator.update("Loss/alpha_loss", float(losses[2]))
+
+        if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
+            metrics = aggregator.compute()
+            timers = timer.compute()
+            if timers.get("Time/env_interaction_time", 0) > 0:
+                metrics["Time/sps_env_interaction"] = (
+                    (policy_step_count - last_log) / timers["Time/env_interaction_time"]
+                )
+            if runtime.is_global_zero:
+                logger.log_metrics(metrics, policy_step_count)
+            aggregator.reset()
+            timer.reset()
+            last_log = policy_step_count
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step_count
+            ckpt_state = {
+                "agent": jax.tree_util.tree_map(np.asarray, params),
+                "opt_states": jax.tree_util.tree_map(np.asarray, opt_states),
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num,
+                "policy_step": policy_step_count,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "batch_size": batch_size * world_size,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
+            runtime.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test_env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+        cumulative_rew = test(actor_def.apply, params["actor"], test_env, runtime, cfg, log_dir)
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, policy_step_count)
+    logger.finalize()

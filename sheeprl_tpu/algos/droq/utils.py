@@ -1,0 +1,14 @@
+"""DroQ helper surface (reference /root/reference/sheeprl/algos/droq/utils.py)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}

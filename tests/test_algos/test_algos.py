@@ -204,6 +204,21 @@ def test_dreamer_v3_resume(devices):
     _run_cli(*args, f"checkpoint.resume_from={ckpts[-1]}")
 
 
+def test_droq(devices):
+    _run_cli(
+        "exp=droq",
+        *COMMON,
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "env.id=continuous_dummy",
+        "buffer.size=64",
+        "algo.learning_starts=0",
+        "algo.per_rank_batch_size=4",
+        "algo.mlp_keys.encoder=[state]",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
 def test_unknown_algorithm_raises():
     with pytest.raises(Exception):
         _run_cli("exp=ppo", "algo.name=not_a_real_algo", "env=dummy", "fabric.accelerator=cpu")
