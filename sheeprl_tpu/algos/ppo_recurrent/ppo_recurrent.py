@@ -1,0 +1,405 @@
+"""Recurrent-PPO training loop — TPU-native re-design of
+/root/reference/sheeprl/algos/ppo_recurrent/ppo_recurrent.py:30-524.
+
+The reference splits the rollout into episodes, pads them and trains with
+`pack_padded_sequence` masking (ppo_recurrent.py:420-447).  Ragged episodes
+are hostile to XLA's static shapes, so this build uses the equivalent
+fixed-length formulation: the rollout ``[T, N]`` is cut into sequences of
+``per_rank_sequence_length`` (T must be a multiple, like the reference
+requires at :226), each sequence starts from its stored LSTM state, and the
+`reset_recurrent_state_on_done` semantics are preserved by in-graph masked
+state resets at done steps.  No padding, no masks, one `lax.scan` per BPTT.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+from sheeprl_tpu.algos.ppo_recurrent.utils import (  # noqa: F401
+    AGGREGATOR_KEYS,
+    MODELS_TO_REGISTER,
+    prepare_obs,
+    test,
+)
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.ops.numerics import gae
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+
+def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, seq_batch: int):
+    """Jitted update over sequence minibatches: data leaves are
+    ``[L, S, ...]`` with S sequences sharded over the mesh."""
+    world = mesh.devices.size
+    distributed = world > 1
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+
+    def loss_fn(params, batch, clip_coef, ent_coef, vf_coef):
+        _, new_logprobs, entropy, new_values, _ = agent.apply(
+            params,
+            {k: batch[k] for k in obs_keys},
+            batch["prev_actions"],
+            batch["hx0"][0],
+            batch["cx0"][0],
+            resets=batch["resets"],
+            actions=batch["actions"],
+        )
+        advantages = batch["advantages"]
+        if cfg.algo.normalize_advantages:
+            mu, std = advantages.mean(), advantages.std()
+            if distributed:
+                mu, std = jax.lax.pmean(mu, "data"), jax.lax.pmean(std, "data")
+            advantages = (advantages - mu) / (std + 1e-8)
+        pg_loss = policy_loss(new_logprobs, batch["logprobs"], advantages, clip_coef, "mean")
+        v_loss = value_loss(
+            new_values, batch["values"], batch["returns"], clip_coef, cfg.algo.clip_vloss, "mean"
+        )
+        e_loss = entropy_loss(entropy, cfg.algo.loss_reduction)
+        return pg_loss + vf_coef * v_loss + ent_coef * e_loss, (pg_loss, v_loss, e_loss)
+
+    def update(params, opt_state, data, key, coefs):
+        clip_coef, ent_coef, vf_coef = coefs
+        n_local = num_minibatches * seq_batch
+
+        def epoch_body(carry, epoch_key):
+            params, opt_state = carry
+            perm = jax.random.permutation(epoch_key, n_local)
+            idxs = perm.reshape(num_minibatches, seq_batch)
+
+            def mb_body(carry, mb_idx):
+                params, opt_state = carry
+                mb = jax.tree_util.tree_map(lambda x: x[:, mb_idx], data)
+                grads, aux = jax.grad(loss_fn, has_aux=True)(params, mb, clip_coef, ent_coef, vf_coef)
+                if distributed:
+                    grads = jax.lax.pmean(grads, "data")
+                    aux = jax.lax.pmean(aux, "data")
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), jnp.stack(aux)
+
+            return jax.lax.scan(mb_body, (params, opt_state), idxs)
+
+        keys = jax.random.split(key, cfg.algo.update_epochs)
+        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), keys)
+        return params, opt_state, jnp.mean(losses.reshape(-1, 3), axis=0)
+
+    if distributed:
+        from jax import shard_map
+
+        def sharded(params, opt_state, data, key, coefs):
+            def body(params, opt_state, data, key, coefs):
+                key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+                return update(params, opt_state, data, key, coefs)
+
+            # every data leaf is [L|1, S, ...]: shard the sequence axis
+            return shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(), P(), P(None, "data"), P(), P()),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+            )(params, opt_state, data, key, coefs)
+
+        return jax.jit(sharded, donate_argnums=(0, 1))
+    return jax.jit(update, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg):
+    world_size = runtime.world_size
+    num_envs = cfg.env.num_envs
+    rollout_steps = cfg.algo.rollout_steps
+    seq_len = cfg.algo.per_rank_sequence_length
+    if not seq_len or seq_len <= 0:
+        raise ValueError(f"per_rank_sequence_length must be positive, got {seq_len}")
+    if rollout_steps % seq_len != 0:
+        raise ValueError(
+            f"rollout_steps ({rollout_steps}) must be a multiple of per_rank_sequence_length ({seq_len})"
+        )
+    num_sequences = (rollout_steps // seq_len) * num_envs
+    if num_sequences % world_size != 0:
+        raise ValueError(
+            f"Number of sequences ({num_sequences}) must be divisible by the number of devices ({world_size})"
+        )
+    seq_per_device = num_sequences // world_size
+    num_batches = max(1, cfg.algo.get("per_rank_num_batches", 4))
+    seq_batch = max(1, seq_per_device // num_batches)
+    num_minibatches = seq_per_device // seq_batch
+
+    rng_key = runtime.seed_everything(cfg.seed)
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+    if cfg.metric.log_level == 0:
+        aggregator.disabled = True
+    timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+
+    envs = vectorized_env(
+        [make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i) for i in range(num_envs)],
+        sync=cfg.env.sync_env,
+    )
+    observation_space = envs.single_observation_space
+    action_space = envs.single_action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = cfg.algo.cnn_keys.encoder
+    mlp_keys = cfg.algo.mlp_keys.encoder
+    obs_keys = list(cnn_keys) + list(mlp_keys)
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    act_sum = int(sum(actions_dim)) if not is_continuous else int(np.prod(action_space.shape))
+
+    state = runtime.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    agent, params, _ = build_agent(
+        runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
+    )
+    policy_steps_per_iter = int(num_envs * rollout_steps)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    if cfg.algo.anneal_lr:
+        schedule = optax.linear_schedule(
+            init_value=cfg.algo.optimizer.learning_rate,
+            end_value=0.0,
+            transition_steps=max(1, total_iters * cfg.algo.update_epochs * num_minibatches),
+        )
+        base_opt = instantiate(cfg.algo.optimizer, learning_rate=schedule)
+    else:
+        base_opt = instantiate(cfg.algo.optimizer)
+    chain = []
+    if cfg.algo.max_grad_norm and cfg.algo.max_grad_norm > 0:
+        chain.append(optax.clip_by_global_norm(cfg.algo.max_grad_norm))
+    chain.append(base_opt)
+    optimizer = optax.chain(*chain)
+    opt_state = optimizer.init(params)
+    if state and "opt_state" in state:
+        opt_state = jax.tree_util.tree_map(
+            lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
+            opt_state,
+            state["opt_state"],
+        )
+
+    train_step = make_train_step(agent, optimizer, cfg, runtime.mesh, num_minibatches, seq_batch)
+
+    hidden = cfg.algo.rnn.lstm.hidden_size
+
+    @jax.jit
+    def policy_step(params, obs, prev_actions, hx, cx, key):
+        actions, logprobs, _, values, (hx, cx) = agent.apply(
+            params, obs, prev_actions, hx, cx, key=key
+        )
+        return actions, logprobs, values, hx, cx
+
+    @jax.jit
+    def value_step(params, obs, prev_actions, hx, cx):
+        return agent.apply(params, obs, prev_actions, hx, cx, method="get_values")
+
+    rb = ReplayBuffer(
+        rollout_steps,
+        num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer"),
+        obs_keys=obs_keys,
+    )
+
+    start_iter = (state["iter_num"] if state else 0) + 1
+    policy_step_count = state["policy_step"] if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+
+    initial_ent = cfg.algo.ent_coef
+    initial_clip = cfg.algo.clip_coef
+    ent_coef = initial_ent
+    clip_coef = initial_clip
+
+    obs, _ = envs.reset(seed=cfg.seed)
+    hx = jnp.zeros((num_envs, hidden), jnp.float32)
+    cx = jnp.zeros((num_envs, hidden), jnp.float32)
+    prev_actions_np = np.zeros((num_envs, act_sum), np.float32)
+    prev_dones = np.zeros((num_envs, 1), np.float32)
+
+    for iter_num in range(start_iter, total_iters + 1):
+        with timer("Time/env_interaction_time"):
+            for _ in range(rollout_steps):
+                policy_step_count += num_envs
+                rng_key, step_key = jax.random.split(rng_key)
+                torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+                # reset state on done BEFORE stepping (reference resets at episode starts)
+                if cfg.algo.reset_recurrent_state_on_done and prev_dones.any():
+                    mask = jnp.asarray(1.0 - prev_dones, jnp.float32)
+                    hx = hx * mask
+                    cx = cx * mask
+                    prev_actions_np = prev_actions_np * (1.0 - prev_dones)
+                hx0_np = np.asarray(hx)
+                cx0_np = np.asarray(cx)
+                actions, logprobs, values, hx, cx = policy_step(
+                    params, torch_obs, jnp.asarray(prev_actions_np)[None], hx, cx, step_key
+                )
+                actions_np = np.asarray(actions)[0]
+                if is_continuous:
+                    env_actions = actions_np.reshape(num_envs, -1)
+                elif is_multidiscrete:
+                    env_actions = actions_np.astype(np.int64)
+                else:
+                    env_actions = actions_np[:, 0].astype(np.int64)
+
+                next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
+                if cfg.env.clip_rewards:
+                    rewards = np.tanh(rewards)
+
+                step_data: Dict[str, np.ndarray] = {}
+                for k in obs_keys:
+                    step_data[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[1:])
+                step_data["actions"] = actions_np.reshape(1, num_envs, -1)
+                step_data["prev_actions"] = prev_actions_np.reshape(1, num_envs, -1)
+                step_data["logprobs"] = np.asarray(logprobs)[0].reshape(1, num_envs, -1)
+                step_data["values"] = np.asarray(values)[0].reshape(1, num_envs, -1)
+                step_data["rewards"] = rewards.reshape(1, num_envs, -1)
+                step_data["dones"] = dones.reshape(1, num_envs, -1)
+                step_data["resets"] = prev_dones.reshape(1, num_envs, -1)
+                step_data["hx"] = hx0_np.reshape(1, num_envs, -1)
+                step_data["cx"] = cx0_np.reshape(1, num_envs, -1)
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                if "final_info" in info and "episode" in info["final_info"]:
+                    ep = info["final_info"]["episode"]
+                    mask = ep.get("_r", info["final_info"].get("_episode"))
+                    if mask is not None and np.any(mask):
+                        for r, l in zip(ep["r"][mask], ep["l"][mask]):
+                            aggregator.update("Rewards/rew_avg", float(r))
+                            aggregator.update("Game/ep_len_avg", float(l))
+
+                # prev-action input to the RNN is one-hot for discrete heads
+                # (reference ppo_recurrent.py:284,356: dim = sum(actions_dim))
+                if is_continuous:
+                    prev_actions_np = actions_np.reshape(num_envs, -1).astype(np.float32)
+                else:
+                    onehots = []
+                    for j, d in enumerate(actions_dim):
+                        onehots.append(np.eye(d, dtype=np.float32)[actions_np[:, j].astype(np.int64)])
+                    prev_actions_np = np.concatenate(onehots, axis=-1)
+                prev_dones = dones
+                obs = next_obs
+
+        # bootstrap + GAE (reference ppo_recurrent.py:358-396)
+        local = {k: np.asarray(rb[k][:rollout_steps]) for k in rb.buffer.keys()}
+        torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+        next_values = value_step(params, torch_obs, jnp.asarray(prev_actions_np)[None], hx, cx)
+        returns, advantages = gae(
+            jnp.asarray(local["rewards"]),
+            jnp.asarray(local["values"]),
+            jnp.asarray(local["dones"]),
+            jnp.asarray(np.asarray(next_values)[0]),
+            rollout_steps,
+            cfg.algo.gamma,
+            cfg.algo.gae_lambda,
+        )
+        local["returns"] = np.asarray(returns)
+        local["advantages"] = np.asarray(advantages)
+
+        # [T, N, ...] -> sequences [L, S, ...], S = (T/L)*N
+        def to_seq(x):
+            T, N = x.shape[:2]
+            chunks = T // seq_len
+            return (
+                x.reshape(chunks, seq_len, N, *x.shape[2:])
+                .swapaxes(1, 2)
+                .reshape(chunks * N, seq_len, *x.shape[2:])
+                .swapaxes(0, 1)
+            )
+
+        data = {k: to_seq(local[k]) for k in local.keys() if k not in ("hx", "cx")}
+        # initial LSTM state of each sequence = stored state at its first step
+        data["hx0"] = to_seq(local["hx"])[:1]
+        data["cx0"] = to_seq(local["cx"])[:1]
+        device_data = jax.tree_util.tree_map(jnp.asarray, data)
+        if world_size > 1:
+            from sheeprl_tpu.parallel.mesh import replicated_sharding
+            from jax.sharding import NamedSharding
+
+            seq_sharding = NamedSharding(runtime.mesh, P(None, "data"))
+            device_data = jax.tree_util.tree_map(lambda x: jax.device_put(x, seq_sharding), device_data)
+
+        if cfg.algo.anneal_clip_coef:
+            clip_coef = polynomial_decay(
+                iter_num, initial=initial_clip, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef = polynomial_decay(
+                iter_num, initial=initial_ent, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+
+        with timer("Time/train_time"):
+            rng_key, train_key = jax.random.split(rng_key)
+            coefs = (
+                jnp.asarray(clip_coef, jnp.float32),
+                jnp.asarray(ent_coef, jnp.float32),
+                jnp.asarray(cfg.algo.vf_coef, jnp.float32),
+            )
+            params, opt_state, losses = train_step(params, opt_state, device_data, train_key, coefs)
+            losses = np.asarray(losses)
+
+        aggregator.update("Loss/policy_loss", float(losses[0]))
+        aggregator.update("Loss/value_loss", float(losses[1]))
+        aggregator.update("Loss/entropy_loss", float(losses[2]))
+
+        if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
+            metrics = aggregator.compute()
+            timers = timer.compute()
+            if timers.get("Time/env_interaction_time", 0) > 0:
+                metrics["Time/sps_env_interaction"] = (
+                    (policy_step_count - last_log) / timers["Time/env_interaction_time"]
+                )
+            if runtime.is_global_zero:
+                logger.log_metrics(metrics, policy_step_count)
+            aggregator.reset()
+            timer.reset()
+            last_log = policy_step_count
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step_count
+            ckpt_state = {
+                "agent": jax.tree_util.tree_map(np.asarray, params),
+                "opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
+                "iter_num": iter_num,
+                "policy_step": policy_step_count,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "batch_size": seq_batch * world_size,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
+            runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state, replay_buffer=None)
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test_env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+        cumulative_rew = test(agent.apply, params, test_env, runtime, cfg, log_dir)
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, policy_step_count)
+    logger.finalize()

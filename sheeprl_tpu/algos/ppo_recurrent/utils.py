@@ -1,0 +1,77 @@
+"""Recurrent-PPO helper surface (reference /root/reference/sheeprl/algos/ppo_recurrent/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.utils import prepare_obs as _ppo_prepare_obs
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
+) -> Dict[str, jax.Array]:
+    """Like PPO's but with a leading sequence axis of 1: ``[1, N, ...]``."""
+    out = _ppo_prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+    return {k: v[None] for k, v in out.items()}
+
+
+def test(agent_apply, params, env, runtime, cfg, log_dir: str) -> float:
+    """One greedy episode carrying LSTM state (reference utils.py:19-66)."""
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=cfg.seed)
+    cnn_keys = cfg.algo.cnn_keys.encoder
+    mlp_keys = cfg.algo.mlp_keys.encoder
+    hidden = cfg.algo.rnn.lstm.hidden_size
+    hx = jnp.zeros((1, hidden), jnp.float32)
+    cx = jnp.zeros((1, hidden), jnp.float32)
+    import gymnasium as gym
+
+    if isinstance(env.action_space, gym.spaces.Discrete):
+        actions_dim = [int(env.action_space.n)]
+    elif isinstance(env.action_space, gym.spaces.MultiDiscrete):
+        actions_dim = [int(d) for d in env.action_space.nvec]
+    else:
+        actions_dim = list(env.action_space.shape)
+    act_sum = int(np.sum(actions_dim))
+    prev_actions = jnp.zeros((1, 1, act_sum), jnp.float32)
+    key = jax.random.PRNGKey(cfg.seed or 0)
+    while not done:
+        torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys)
+        actions, _, _, _, (hx, cx) = agent_apply(
+            params, torch_obs, prev_actions, hx, cx, key=key, greedy=True
+        )
+        actions_np = np.asarray(actions)
+        if isinstance(env.action_space, gym.spaces.Box):
+            prev_actions = actions
+            env_actions = actions_np.reshape(env.action_space.shape)
+        else:
+            onehots = [
+                np.eye(d, dtype=np.float32)[actions_np[0, :, j].astype(np.int64)]
+                for j, d in enumerate(actions_dim)
+            ]
+            prev_actions = jnp.asarray(np.concatenate(onehots, axis=-1))[None]
+            if isinstance(env.action_space, gym.spaces.Discrete):
+                env_actions = int(actions_np[0, 0, 0])
+            else:
+                env_actions = actions_np[0, 0].astype(np.int64)
+        obs, reward, terminated, truncated, _ = env.step(env_actions)
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    env.close()
+    return cumulative_rew

@@ -299,29 +299,36 @@ def compose(
             order.append(g)
 
     # Experiment overlays are @package _global_ and may override group choices.
+    # An exp file's defaults list may also include *sibling* exp files by bare
+    # name (e.g. exp/ppo_recurrent.yaml starts from `- ppo`): those merge
+    # first, recursively, each applying its own `override /group:` entries.
     exp_entries: List[Tuple[str, Dict[str, Any]]] = []
+
+    def _collect_exp(option: str) -> None:
+        path = _find_config_file("exp", option, dirs)
+        data, _ = _load_yaml(path)
+        for d_entry in data.get("defaults", []):
+            if isinstance(d_entry, str):
+                if d_entry != "_self_":
+                    _collect_exp(d_entry)
+            elif isinstance(d_entry, dict):
+                for key, value in d_entry.items():
+                    key = str(key)
+                    if key.startswith("override"):
+                        target = key.split("/", 1)[1].strip()
+                        # CLI group selections beat the experiment file
+                        if target not in group_sel:
+                            selections[target] = str(value)
+        exp_entries.append(("exp", data))
+
     for g in list(order):
         opt = selections.get(g, "???")
         if opt == "???":
             continue
-        path_try = None
-        try:
-            path_try = _find_config_file(g, opt, dirs)
-        except ConfigError:
-            raise
+        path_try = _find_config_file(g, opt, dirs)
         _, is_global = _load_yaml(path_try)
         if is_global and g in ("exp",):
-            data, _ = _load_yaml(path_try)
-            for d_entry in data.get("defaults", []):
-                if isinstance(d_entry, dict):
-                    for key, value in d_entry.items():
-                        key = str(key)
-                        if key.startswith("override"):
-                            target = key.split("/", 1)[1].strip()
-                            # CLI group selections beat the experiment file
-                            if target not in group_sel:
-                                selections[target] = str(value)
-            exp_entries.append((g, data))
+            _collect_exp(opt)
 
     missing_groups = [g for g in order if selections.get(g) == "???" and g not in ("exp",)]
     if selections.get("exp") == "???" and not any(g == "exp" for g, _ in exp_entries):

@@ -219,6 +219,24 @@ def test_droq(devices):
     assert _checkpoint_paths(), "no checkpoint written"
 
 
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_ppo_recurrent(devices, env_id):
+    _run_cli(
+        "exp=ppo_recurrent",
+        *COMMON,
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        f"env.id={env_id}",
+        "algo.rollout_steps=8",
+        "algo.per_rank_sequence_length=4",
+        "algo.per_rank_num_batches=2",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
 def test_unknown_algorithm_raises():
     with pytest.raises(Exception):
         _run_cli("exp=ppo", "algo.name=not_a_real_algo", "env=dummy", "fabric.accelerator=cpu")
