@@ -1,44 +1,120 @@
 """Benchmark harness: prints ONE JSON line
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
 
-Mirrors the reference's wall-clock benchmark (reference
-/root/reference/benchmarks/benchmark.py + configs/exp/ppo_benchmarks.yaml):
-PPO on CartPole-v1, 65536 env steps, logging/test/checkpoint disabled.
-Baseline: SheepRL v0.5.5 on 4 CPUs = 81.27 s (BASELINE.md §B), i.e.
-~806 env-steps/s. ``vs_baseline`` is the throughput ratio (ours / reference,
-higher is better).
+Measures the flagship compute path: DreamerV3-S gradient steps/sec on one
+chip, batch 16 x sequence 64 on 64x64x3 pixels — the Atari-100K training
+configuration (reference configs/exp/dreamer_v3_100k_ms_pacman.yaml; SURVEY
+§6 / BASELINE.md §C name env-steps/sec/chip for DreamerV3 as the north-star
+metric, and with replay_ratio=1 one gradient step IS one policy step).
+
+Baseline: the reference trains Atari-100K (MsPacman, DV3-S, replay_ratio 1,
+action_repeat 4 → ~25_000 gradient steps) in 14 h on one RTX-3080
+(reference README.md:46-53) → 25_000 / 50_400 s ≈ 0.496 gradient-steps/s
+end-to-end.  ``vs_baseline`` = ours / 0.496 (higher is better).
 """
 
 from __future__ import annotations
 
 import json
-import sys
 import time
 
-PPO_BASELINE_SECONDS = 81.27  # reference 1-device wall clock (BASELINE.md §B)
-TOTAL_STEPS = 65536
+BASELINE_GRAD_STEPS_PER_SEC = 25_000 / (14 * 3600)
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
 
 
 def main() -> None:
-    from sheeprl_tpu.cli import run
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
 
-    args = [
-        "exp=ppo_benchmarks",
-        "env.capture_video=False",
-        "checkpoint.save_last=False",
-    ]
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_step
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments_state
+    from sheeprl_tpu.config import compose, instantiate
+
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo=dreamer_v3_S",
+            "algo.per_rank_batch_size=16",
+            "algo.per_rank_sequence_length=64",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+            "env.capture_video=False",
+            "metric.log_level=0",
+        ]
+    )
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    actions_dim = (6,)  # MsPacman action space size
+    world_model_def, actor_def, critic_def, params = build_agent(
+        None, actions_dim, False, cfg, obs_space
+    )
+    optimizers = {
+        "world_model": optax.chain(
+            optax.clip_by_global_norm(cfg.algo.world_model.clip_gradients),
+            instantiate(cfg.algo.world_model.optimizer),
+        ),
+        "actor": optax.chain(
+            optax.clip_by_global_norm(cfg.algo.actor.clip_gradients),
+            instantiate(cfg.algo.actor.optimizer),
+        ),
+        "critic": optax.chain(
+            optax.clip_by_global_norm(cfg.algo.critic.clip_gradients),
+            instantiate(cfg.algo.critic.optimizer),
+        ),
+    }
+    opt_states = {k.replace("world_model", "world_model"): None for k in ()}
+    opt_states = {
+        "world_model": optimizers["world_model"].init(params["world_model"]),
+        "actor": optimizers["actor"].init(params["actor"]),
+        "critic": optimizers["critic"].init(params["critic"]),
+    }
+    moments_state = init_moments_state()
+    train_step = make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, False)
+
+    T, B = cfg.algo.per_rank_sequence_length, cfg.algo.per_rank_batch_size
+    rng = np.random.default_rng(0)
+    batch = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 3, 64, 64)), jnp.float32) / 255.0 - 0.5,
+        "actions": jnp.asarray(rng.integers(0, 2, (T, B, actions_dim[0])), jnp.float32),
+        "rewards": jnp.asarray(rng.normal(size=(T, B, 1)), jnp.float32),
+        "terminated": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+    key = jax.random.PRNGKey(0)
+    tau = jnp.float32(0.02)
+
+    for _ in range(WARMUP_STEPS):
+        key, sub = jax.random.split(key)
+        params, opt_states, moments_state, metrics = train_step(
+            params, opt_states, moments_state, batch, sub, tau
+        )
+    jax.block_until_ready(metrics)
+
     tic = time.perf_counter()
-    run(args)
+    for _ in range(MEASURE_STEPS):
+        key, sub = jax.random.split(key)
+        params, opt_states, moments_state, metrics = train_step(
+            params, opt_states, moments_state, batch, sub, tau
+        )
+    jax.block_until_ready(metrics)
     elapsed = time.perf_counter() - tic
-    sps = TOTAL_STEPS / elapsed
-    baseline_sps = TOTAL_STEPS / PPO_BASELINE_SECONDS
+    steps_per_sec = MEASURE_STEPS / elapsed
+
     print(
         json.dumps(
             {
-                "metric": "ppo_cartpole_env_steps_per_sec",
-                "value": round(sps, 2),
-                "unit": "env-steps/s",
-                "vs_baseline": round(sps / baseline_sps, 3),
+                "metric": "dreamer_v3_S_grad_steps_per_sec",
+                "value": round(steps_per_sec, 3),
+                "unit": "grad-steps/s (batch 16 x seq 64, 64x64x3)",
+                "vs_baseline": round(steps_per_sec / BASELINE_GRAD_STEPS_PER_SEC, 3),
             }
         )
     )

@@ -1,0 +1,260 @@
+"""Decoupled SAC — TPU-native re-design of
+/root/reference/sheeprl/algos/sac/sac_decoupled.py:33-588.
+
+Same topology translation as decoupled PPO (ppo_decoupled.py): device 0 is
+the buffer-resident player, devices 1..N-1 the trainer mesh.  The reference
+scatters sampled batch data from the player to the trainer DDP group
+(sac_decoupled.py:294-320) and broadcasts flat parameters back; here the
+sampled replay batches are ``device_put`` sharded over the trainer sub-mesh
+and the actor params hop back to the player device each iteration.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.algos.sac.agent import build_agent
+from sheeprl_tpu.algos.sac.sac import make_train_step
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+@register_algorithm(decoupled=True)
+def main(runtime, cfg):
+    world_size = runtime.world_size
+    if world_size < 2:
+        raise RuntimeError(
+            "Decoupled SAC needs at least 2 devices: 1 player + >=1 trainer "
+            f"(got fabric.devices={world_size})"
+        )
+    player_device = runtime.devices[0]
+    trainer_devices = runtime.devices[1:]
+    trainer_mesh = Mesh(np.asarray(trainer_devices), ("data",))
+    n_trainers = len(trainer_devices)
+    num_envs = cfg.env.num_envs
+
+    if cfg.algo.cnn_keys.encoder:
+        import warnings
+
+        warnings.warn("SAC only uses vector observations; CNN keys are ignored")
+
+    rng_key = runtime.seed_everything(cfg.seed)
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+    if cfg.metric.log_level == 0:
+        aggregator.disabled = True
+    timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+
+    envs = vectorized_env(
+        [make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i) for i in range(num_envs)],
+        sync=cfg.env.sync_env,
+    )
+    observation_space = envs.single_observation_space
+    action_space = envs.single_action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("SAC supports only continuous (Box) action spaces")
+    mlp_keys = cfg.algo.mlp_keys.encoder
+
+    state = runtime.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    actor_def, critic_def, params, target_entropy = build_agent(
+        runtime, cfg, observation_space, action_space, state["agent"] if state else None
+    )
+    optimizers = {
+        "actor": instantiate(cfg.algo.actor.optimizer),
+        "critic": instantiate(cfg.algo.critic.optimizer),
+        "alpha": instantiate(cfg.algo.alpha.optimizer),
+    }
+    opt_states = {
+        "actor": optimizers["actor"].init(params["actor"]),
+        "critic": optimizers["critic"].init(params["critic"]),
+        "alpha": optimizers["alpha"].init(params["log_alpha"]),
+    }
+    if state and "opt_states" in state:
+        opt_states = jax.tree_util.tree_map(
+            lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
+            opt_states,
+            state["opt_states"],
+        )
+
+    trainer_repl = NamedSharding(trainer_mesh, P())
+    trainer_data_sharding = NamedSharding(trainer_mesh, P(None, "data"))
+    params = jax.device_put(params, trainer_repl)
+    opt_states = jax.device_put(opt_states, trainer_repl)
+    player_actor_params = jax.device_put(
+        jax.tree_util.tree_map(np.asarray, params["actor"]), player_device
+    )
+
+    train_step = make_train_step(actor_def, critic_def, optimizers, cfg, trainer_mesh, target_entropy)
+
+    @jax.jit
+    def _policy_step(actor_params, obs, key):
+        actions, _ = actor_def.apply(actor_params, obs, key, method="sample_and_log_prob")
+        return actions
+
+    def policy_step(actor_params, obs, key):
+        return _policy_step(actor_params, jax.device_put(obs, player_device), key)
+
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer"),
+        obs_keys=("observations",),
+    )
+    if state and "rb" in state and state["rb"] is not None:
+        rb.load_state_dict(state["rb"])
+
+    start_iter = (state["iter_num"] if state else 0) + 1
+    policy_step_count = state["policy_step"] if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if cfg.checkpoint.resume_from:
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
+
+    batch_size = cfg.algo.per_rank_batch_size
+    obs, _ = envs.reset(seed=cfg.seed)
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step_count += policy_steps_per_iter
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                rng_key, step_key = jax.random.split(rng_key)
+                flat_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
+                actions = np.asarray(policy_step(player_actor_params, flat_obs, step_key))
+            next_obs, rewards, terminated, truncated, info = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, -1)
+
+        if "final_info" in info and "episode" in info["final_info"]:
+            ep = info["final_info"]["episode"]
+            mask = ep.get("_r", info["final_info"].get("_episode"))
+            if mask is not None and np.any(mask):
+                for r, l in zip(ep["r"][mask], ep["l"][mask]):
+                    aggregator.update("Rewards/rew_avg", float(r))
+                    aggregator.update("Game/ep_len_avg", float(l))
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in mlp_keys}
+        if "final_obs" in info:
+            for idx, final_obs in enumerate(info["final_obs"]):
+                if final_obs is not None:
+                    for k in mlp_keys:
+                        real_next_obs[k][idx] = np.asarray(final_obs[k])
+
+        step_data: Dict[str, np.ndarray] = {}
+        step_data["observations"] = np.concatenate(
+            [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+        )[np.newaxis]
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = np.concatenate(
+                [real_next_obs[k].astype(np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+            )[np.newaxis]
+        step_data["actions"] = actions.reshape(1, num_envs, -1)
+        step_data["rewards"] = rewards[np.newaxis]
+        step_data["terminated"] = np.asarray(terminated).reshape(1, num_envs, -1).astype(np.float32)
+        step_data["truncated"] = np.asarray(truncated).reshape(1, num_envs, -1).astype(np.float32)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        obs = next_obs
+
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step_count - prefill_steps * policy_steps_per_iter)
+            if cfg.dry_run:
+                per_rank_gradient_steps = 1
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    # player samples; batches "scattered" onto the trainer mesh
+                    sample = rb.sample(
+                        batch_size=batch_size * n_trainers,
+                        n_samples=per_rank_gradient_steps,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
+                    )
+                    data = {
+                        k: jax.device_put(jnp.asarray(np.asarray(v), jnp.float32), trainer_data_sharding)
+                        for k, v in sample.items()
+                        if k in ("observations", "next_observations", "actions", "rewards", "terminated")
+                    }
+                    rng_key, scan_key = jax.random.split(rng_key)
+                    keys = jax.random.split(scan_key, per_rank_gradient_steps)
+                    params, opt_states, losses = train_step(params, opt_states, data, keys)
+                    losses = np.asarray(losses)
+                # actor params broadcast back to the player (reference :550-554)
+                player_actor_params = jax.device_put(params["actor"], player_device)
+                aggregator.update("Loss/value_loss", float(losses[0]))
+                aggregator.update("Loss/policy_loss", float(losses[1]))
+                aggregator.update("Loss/alpha_loss", float(losses[2]))
+
+        if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
+            metrics = aggregator.compute()
+            timers = timer.compute()
+            if timers.get("Time/env_interaction_time", 0) > 0:
+                metrics["Time/sps_env_interaction"] = (
+                    (policy_step_count - last_log) / timers["Time/env_interaction_time"]
+                )
+            if runtime.is_global_zero:
+                logger.log_metrics(metrics, policy_step_count)
+            aggregator.reset()
+            timer.reset()
+            last_log = policy_step_count
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step_count
+            ckpt_state = {
+                "agent": jax.tree_util.tree_map(np.asarray, params),
+                "opt_states": jax.tree_util.tree_map(np.asarray, opt_states),
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num,
+                "policy_step": policy_step_count,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "batch_size": batch_size * n_trainers,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
+            runtime.call(
+                "on_checkpoint_player",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test_env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+        cumulative_rew = test(actor_def.apply, player_actor_params, test_env, runtime, cfg, log_dir)
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, policy_step_count)
+    logger.finalize()

@@ -237,6 +237,50 @@ def test_ppo_recurrent(devices, env_id):
     assert _checkpoint_paths(), "no checkpoint written"
 
 
+def test_ppo_decoupled():
+    _run_cli(
+        "exp=ppo_decoupled",
+        *COMMON,
+        "fabric.devices=2",
+        "fabric.accelerator=cpu",
+        "env.id=discrete_dummy",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
+def test_ppo_decoupled_single_device_raises():
+    with pytest.raises(Exception):
+        _run_cli(
+            "exp=ppo_decoupled",
+            *COMMON,
+            "fabric.devices=1",
+            "fabric.accelerator=cpu",
+            "env.id=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+        )
+
+
+def test_sac_decoupled():
+    _run_cli(
+        "exp=sac_decoupled",
+        *COMMON,
+        "fabric.devices=2",
+        "fabric.accelerator=cpu",
+        "env.id=continuous_dummy",
+        "buffer.size=64",
+        "algo.learning_starts=0",
+        "algo.per_rank_batch_size=4",
+        "algo.mlp_keys.encoder=[state]",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
 def test_unknown_algorithm_raises():
     with pytest.raises(Exception):
         _run_cli("exp=ppo", "algo.name=not_a_real_algo", "env=dummy", "fabric.accelerator=cpu")
