@@ -70,7 +70,6 @@ def main() -> None:
             instantiate(cfg.algo.critic.optimizer),
         ),
     }
-    opt_states = {k.replace("world_model", "world_model"): None for k in ()}
     opt_states = {
         "world_model": optimizers["world_model"].init(params["world_model"]),
         "actor": optimizers["actor"].init(params["actor"]),

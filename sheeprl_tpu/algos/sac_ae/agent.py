@@ -1,0 +1,240 @@
+"""SAC-AE agent — TPU-native re-design of
+/root/reference/sheeprl/algos/sac_ae/agent.py:26-640 (SAC+AE,
+https://arxiv.org/abs/1910.01741).
+
+Pixel SAC with a convolutional autoencoder: the critic trains the shared
+encoder, the actor sees detached features, and a decoder reconstruction loss
+(+ L2 latent penalty) regularizes the representation.  Convs run NHWC; the
+final transposed conv uses a 4x4 kernel (instead of the reference's 3x3 +
+output_padding) to reproduce the exact 64x64 output shape, which XLA tiles
+better anyway.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.models.blocks import MLP
+
+LOG_STD_MAX = 2.0
+LOG_STD_MIN = -10.0
+
+
+class SACAEEncoder(nn.Module):
+    """4-conv encoder (k3, strides 2/1/1/1) + LayerNorm-tanh projection
+    (reference agent.py:26-87) fused with an MLP branch for vector keys."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    features_dim: int = 64
+    channels_multiplier: int = 1
+    dense_units: int = 64
+    mlp_layers: int = 2
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array], detach_encoder_features: bool = False) -> jax.Array:
+        feats = []
+        if self.cnn_keys:
+            x = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-3)
+            lead = x.shape[:-3]
+            x = x.reshape((-1,) + x.shape[-3:])
+            x = jnp.transpose(x, (0, 2, 3, 1))
+            for stride in (2, 1, 1, 1):
+                x = nn.Conv(32 * self.channels_multiplier, (3, 3), strides=(stride, stride), padding="VALID")(x)
+                x = jax.nn.relu(x)
+            x = x.reshape(lead + (-1,))
+            if detach_encoder_features:
+                x = jax.lax.stop_gradient(x)
+            x = nn.Dense(self.features_dim)(x)
+            x = nn.LayerNorm()(x)
+            feats.append(jnp.tanh(x))
+        if self.mlp_keys:
+            v = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
+            v = MLP(hidden_sizes=[self.dense_units] * self.mlp_layers, activation="relu")(v)
+            if detach_encoder_features:
+                v = jax.lax.stop_gradient(v)
+            v = nn.Dense(self.features_dim)(v)
+            v = nn.LayerNorm()(v)
+            feats.append(jnp.tanh(v))
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+
+class SACAEDecoder(nn.Module):
+    """Inverse of the encoder (reference agent.py:122-201): fc back to the
+    conv feature map, 3 stride-1 deconvs, one stride-2 deconv to 64x64."""
+
+    cnn_keys: Sequence[str]
+    cnn_channels: Sequence[int]
+    mlp_keys: Sequence[str]
+    mlp_dims: Sequence[int]
+    features_dim: int = 64
+    channels_multiplier: int = 1
+    screen_size: int = 64
+    dense_units: int = 64
+    mlp_layers: int = 2
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_keys:
+            conv_hw = (self.screen_size - 3) // 2 + 1 - 6  # 64 -> 31 -> 29 -> 27 -> 25
+            ch = 32 * self.channels_multiplier
+            lead = features.shape[:-1]
+            x = nn.Dense(conv_hw * conv_hw * ch)(features)
+            x = x.reshape((-1, conv_hw, conv_hw, ch))
+            for _ in range(3):
+                x = nn.ConvTranspose(ch, (3, 3), strides=(1, 1), padding="VALID")(x)
+                x = jax.nn.relu(x)
+            x = nn.ConvTranspose(int(sum(self.cnn_channels)), (4, 4), strides=(2, 2), padding="VALID")(x)
+            x = jnp.transpose(x, (0, 3, 1, 2))
+            x = x.reshape(lead + x.shape[1:])
+            start = 0
+            for k, c in zip(self.cnn_keys, self.cnn_channels):
+                out[k] = x[..., start : start + c, :, :]
+                start += c
+        if self.mlp_keys:
+            v = MLP(hidden_sizes=[self.dense_units] * self.mlp_layers, activation="relu")(features)
+            start = 0
+            v = nn.Dense(int(sum(self.mlp_dims)))(v)
+            for k, d in zip(self.mlp_keys, self.mlp_dims):
+                out[k] = v[..., start : start + d]
+                start += d
+        return out
+
+
+class SACAEActor(nn.Module):
+    """Tanh-Gaussian actor over encoder features (reference agent.py:240-318)."""
+
+    action_dim: int
+    hidden_size: int = 1024
+    action_low: Sequence[float] | float = -1.0
+    action_high: Sequence[float] | float = 1.0
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), activation="relu")(features)
+        mean = nn.Dense(self.action_dim)(x)
+        log_std = nn.Dense(self.action_dim)(x)
+        std = jnp.exp(jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+        return mean, std
+
+    def _scale(self):
+        low = jnp.asarray(self.action_low, jnp.float32)
+        high = jnp.asarray(self.action_high, jnp.float32)
+        return (high - low) / 2.0, (high + low) / 2.0
+
+    def sample_and_log_prob(self, features: jax.Array, key: jax.Array):
+        mean, std = self(features)
+        scale, bias = self._scale()
+        x_t = mean + std * jax.random.normal(key, mean.shape)
+        y_t = jnp.tanh(x_t)
+        action = y_t * scale + bias
+        var = std**2
+        log_prob = -((x_t - mean) ** 2) / (2 * var) - jnp.log(std) - 0.5 * jnp.log(2 * jnp.pi)
+        log_prob = log_prob - jnp.log(scale * (1 - y_t**2) + 1e-6)
+        return action, jnp.sum(log_prob, axis=-1, keepdims=True)
+
+    def greedy_action(self, features: jax.Array) -> jax.Array:
+        mean, _ = self(features)
+        scale, bias = self._scale()
+        return jnp.tanh(mean) * scale + bias
+
+
+class _QNetwork(nn.Module):
+    hidden_size: int = 1024
+
+    @nn.compact
+    def __call__(self, features: jax.Array, actions: jax.Array) -> jax.Array:
+        x = jnp.concatenate([features, actions], axis=-1)
+        return MLP(hidden_sizes=(self.hidden_size, self.hidden_size), output_dim=1, activation="relu")(x)
+
+
+class SACAECritics(nn.Module):
+    num_critics: int = 2
+    hidden_size: int = 1024
+
+    @nn.compact
+    def __call__(self, features: jax.Array, actions: jax.Array) -> jax.Array:
+        vmapped = nn.vmap(
+            _QNetwork,
+            in_axes=None,
+            out_axes=-1,
+            axis_size=self.num_critics,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(hidden_size=self.hidden_size)
+        return vmapped(features, actions)[..., 0, :]
+
+
+def build_agent(
+    runtime,
+    cfg,
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+):
+    """Returns (encoder_def, decoder_def, actor_def, critic_def, params,
+    target_entropy) — params holds encoder/decoder/actor/qfs plus the target
+    encoder/qfs copies and log_alpha (reference agent.py:321-640)."""
+    act_dim = int(prod(action_space.shape))
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    encoder_def = SACAEEncoder(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        features_dim=cfg.algo.encoder.features_dim,
+        channels_multiplier=cfg.algo.encoder.cnn_channels_multiplier,
+        dense_units=cfg.algo.encoder.dense_units,
+        mlp_layers=cfg.algo.encoder.mlp_layers,
+    )
+    decoder_def = SACAEDecoder(
+        cnn_keys=tuple(cfg.algo.cnn_keys.decoder),
+        cnn_channels=tuple(int(prod(obs_space[k].shape[:-2])) for k in cfg.algo.cnn_keys.decoder),
+        mlp_keys=tuple(cfg.algo.mlp_keys.decoder),
+        mlp_dims=tuple(int(prod(obs_space[k].shape)) for k in cfg.algo.mlp_keys.decoder),
+        features_dim=cfg.algo.encoder.features_dim,
+        channels_multiplier=cfg.algo.decoder.cnn_channels_multiplier,
+        screen_size=cfg.env.screen_size,
+        dense_units=cfg.algo.decoder.dense_units,
+        mlp_layers=cfg.algo.decoder.mlp_layers,
+    )
+    actor_def = SACAEActor(
+        action_dim=act_dim,
+        hidden_size=cfg.algo.hidden_size,
+        action_low=tuple(np.asarray(action_space.low, np.float32).reshape(-1).tolist()),
+        action_high=tuple(np.asarray(action_space.high, np.float32).reshape(-1).tolist()),
+    )
+    critic_def = SACAECritics(num_critics=cfg.algo.critic.n, hidden_size=cfg.algo.hidden_size)
+
+    keys = jax.random.split(jax.random.PRNGKey(int(cfg.seed or 0)), 4)
+    sample_obs: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        sample_obs[k] = jnp.zeros((1,) + tuple(obs_space[k].shape), jnp.float32)
+    for k in mlp_keys:
+        sample_obs[k] = jnp.zeros((1, int(prod(obs_space[k].shape))), jnp.float32)
+    encoder_params = encoder_def.init(keys[0], sample_obs)
+    feat_dim = cfg.algo.encoder.features_dim * ((1 if cnn_keys else 0) + (1 if mlp_keys else 0))
+    dummy_feat = jnp.zeros((1, feat_dim), jnp.float32)
+    decoder_params = decoder_def.init(keys[1], dummy_feat)
+    actor_params = actor_def.init(keys[2], dummy_feat)
+    critic_params = critic_def.init(keys[3], dummy_feat, jnp.zeros((1, act_dim), jnp.float32))
+    params = {
+        "encoder": encoder_params,
+        "decoder": decoder_params,
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_encoder": jax.tree_util.tree_map(jnp.copy, encoder_params),
+        "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
+        "log_alpha": jnp.log(jnp.asarray([cfg.algo.alpha.alpha], jnp.float32)),
+    }
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    target_entropy = -act_dim
+    return encoder_def, decoder_def, actor_def, critic_def, params, target_entropy

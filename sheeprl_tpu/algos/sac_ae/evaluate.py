@@ -1,0 +1,32 @@
+"""SAC-AE evaluation entrypoint (reference /root/reference/sheeprl/algos/sac_ae/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.utils import test
+from sheeprl_tpu.envs.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="sac_ae")
+def evaluate_sac_ae(runtime, cfg, state: Dict[str, Any]) -> None:
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    encoder_def, _, actor_def, _, params, _ = build_agent(
+        runtime, cfg, observation_space, action_space, state["agent"]
+    )
+    cumulative_rew = test(
+        encoder_def.apply, actor_def.apply, params["encoder"], params["actor"], env, runtime, cfg, log_dir
+    )
+    logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    logger.finalize()

@@ -1,0 +1,404 @@
+"""SAC-AE training loop — TPU-native re-design of
+/root/reference/sheeprl/algos/sac_ae/sac_ae.py:40-502.
+
+One jitted graph per gradient step covering: critic (+shared encoder) update,
+Polyak EMA of the target critic/encoder, frequency-gated actor+alpha update
+(`actor.per_rank_update_freq`), and frequency-gated autoencoder update with
+bit-reduced reconstruction targets and L2 latent penalty — the reference's
+five optimizers become five optax states over disjoint param subtrees, and
+the update-frequency branches are `lax.cond`s driven by the cumulative
+gradient-step counter.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.utils import (  # noqa: F401
+    AGGREGATOR_KEYS,
+    MODELS_TO_REGISTER,
+    prepare_obs,
+    preprocess_obs,
+    test,
+)
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers, cfg, target_entropy):
+    gamma = cfg.algo.gamma
+    tau = cfg.algo.tau
+    encoder_tau = cfg.algo.encoder.tau
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_dec = list(cfg.algo.cnn_keys.decoder)
+    mlp_dec = list(cfg.algo.mlp_keys.decoder)
+    target_freq = cfg.algo.critic.per_rank_target_network_update_freq
+    actor_freq = cfg.algo.actor.per_rank_update_freq
+    decoder_freq = cfg.algo.decoder.per_rank_update_freq
+    l2_lambda = cfg.algo.decoder.l2_lambda
+
+    def one_step(carry, inp):
+        params, opt_states, counter = carry
+        batch, key = inp
+        k_next, k_actor, k_noise = jax.random.split(key, 3)
+
+        obs = {k: batch[k] / 255.0 for k in cnn_keys}
+        obs.update({k: batch[k] for k in mlp_keys})
+        next_obs = {k: batch[f"next_{k}"] / 255.0 for k in cnn_keys}
+        next_obs.update({k: batch[f"next_{k}"] for k in mlp_keys})
+
+        # --- critic (+ encoder) update (reference sac_ae.py:62-71) --------
+        next_features = encoder_def.apply(params["target_encoder"], next_obs)
+        next_actions, next_logprobs = actor_def.apply(
+            params["actor"],
+            encoder_def.apply(params["encoder"], next_obs),
+            k_next,
+            method="sample_and_log_prob",
+        )
+        next_q = critic_def.apply(params["target_critic"], next_features, next_actions)
+        min_next_q = jnp.min(next_q, axis=-1, keepdims=True)
+        alpha = jnp.exp(params["log_alpha"])
+        next_qf_value = jax.lax.stop_gradient(
+            batch["rewards"] + (1 - batch["terminated"]) * gamma * (min_next_q - alpha * next_logprobs)
+        )
+
+        def qf_loss_fn(enc_and_critic):
+            enc_params, critic_params = enc_and_critic
+            features = encoder_def.apply(enc_params, obs)
+            qf_values = critic_def.apply(critic_params, features, batch["actions"])
+            return critic_loss(qf_values, next_qf_value, qf_values.shape[-1])
+
+        qf_l, (enc_grads, critic_grads) = jax.value_and_grad(qf_loss_fn)(
+            (params["encoder"], params["critic"])
+        )
+        updates, opt_states["critic"] = optimizers["critic"].update(
+            (enc_grads, critic_grads), opt_states["critic"], (params["encoder"], params["critic"])
+        )
+        params["encoder"], params["critic"] = optax.apply_updates(
+            (params["encoder"], params["critic"]), updates
+        )
+
+        # --- target EMAs every `target_freq` steps (reference :74-77) -----
+        do_target = (counter % target_freq) == 0
+
+        def _ema(p):
+            p = dict(p)
+            p["target_critic"] = optax.incremental_update(p["critic"], p["target_critic"], tau)
+            p["target_encoder"] = optax.incremental_update(p["encoder"], p["target_encoder"], encoder_tau)
+            return p
+
+        params = jax.lax.cond(do_target, _ema, lambda p: dict(p), params)
+
+        # --- actor + alpha every `actor_freq` steps (reference :79-97) ----
+        def _actor_update(operand):
+            params, opt_states = operand
+            params = dict(params)
+            opt_states = dict(opt_states)
+            features = jax.lax.stop_gradient(encoder_def.apply(params["encoder"], obs))
+
+            def actor_loss_fn(actor_params):
+                actions, logprobs = actor_def.apply(
+                    actor_params, features, k_actor, method="sample_and_log_prob"
+                )
+                q = critic_def.apply(params["critic"], features, actions)
+                min_q = jnp.min(q, axis=-1, keepdims=True)
+                return policy_loss(jnp.exp(params["log_alpha"]), logprobs, min_q), logprobs
+
+            (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+                params["actor"]
+            )
+            updates, opt_states["actor"] = optimizers["actor"].update(
+                actor_grads, opt_states["actor"], params["actor"]
+            )
+            params["actor"] = optax.apply_updates(params["actor"], updates)
+
+            def alpha_loss_fn(log_alpha):
+                return entropy_loss(log_alpha, logprobs, target_entropy)
+
+            alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"])
+            updates, opt_states["alpha"] = optimizers["alpha"].update(
+                alpha_grads, opt_states["alpha"], params["log_alpha"]
+            )
+            params["log_alpha"] = optax.apply_updates(params["log_alpha"], updates)
+            return params, opt_states, actor_l, alpha_l
+
+        def _actor_skip(operand):
+            params, opt_states = operand
+            return dict(params), dict(opt_states), jnp.float32(0), jnp.float32(0)
+
+        params, opt_states, actor_l, alpha_l = jax.lax.cond(
+            (counter % actor_freq) == 0, _actor_update, _actor_skip, (params, opt_states)
+        )
+
+        # --- autoencoder every `decoder_freq` steps (reference :99-117) ---
+        def _ae_update(operand):
+            params, opt_states = operand
+            params = dict(params)
+            opt_states = dict(opt_states)
+
+            def rec_loss_fn(enc_dec):
+                enc_params, dec_params = enc_dec
+                hidden = encoder_def.apply(enc_params, obs)
+                recon = decoder_def.apply(dec_params, hidden)
+                loss = 0.0
+                for k in cnn_dec + mlp_dec:
+                    if k in cnn_dec:
+                        target = preprocess_obs(batch[k], k_noise, bits=5)
+                    else:
+                        target = batch[k]
+                    loss = loss + jnp.mean((target - recon[k]) ** 2)
+                    loss = loss + l2_lambda * jnp.mean(0.5 * jnp.sum(hidden**2, axis=-1))
+                return loss
+
+            rec_l, (enc_grads, dec_grads) = jax.value_and_grad(rec_loss_fn)(
+                (params["encoder"], params["decoder"])
+            )
+            updates, opt_states["encoder"] = optimizers["encoder"].update(
+                enc_grads, opt_states["encoder"], params["encoder"]
+            )
+            params["encoder"] = optax.apply_updates(params["encoder"], updates)
+            updates, opt_states["decoder"] = optimizers["decoder"].update(
+                dec_grads, opt_states["decoder"], params["decoder"]
+            )
+            params["decoder"] = optax.apply_updates(params["decoder"], updates)
+            return params, opt_states, rec_l
+
+        def _ae_skip(operand):
+            params, opt_states = operand
+            return dict(params), dict(opt_states), jnp.float32(0)
+
+        params, opt_states, rec_l = jax.lax.cond(
+            (counter % decoder_freq) == 0, _ae_update, _ae_skip, (params, opt_states)
+        )
+
+        return (params, opt_states, counter + 1), jnp.stack([qf_l, actor_l, alpha_l, rec_l])
+
+    def update(params, opt_states, counter, data, keys):
+        (params, opt_states, counter), losses = jax.lax.scan(
+            one_step, (params, opt_states, counter), (data, keys)
+        )
+        return params, opt_states, counter, jnp.mean(losses, axis=0)
+
+    return jax.jit(update, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg):
+    world_size = runtime.world_size
+    num_envs = cfg.env.num_envs
+    cfg.env.screen_size = 64
+
+    rng_key = runtime.seed_everything(cfg.seed)
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+    if cfg.metric.log_level == 0:
+        aggregator.disabled = True
+    timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+
+    envs = vectorized_env(
+        [make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i) for i in range(num_envs)],
+        sync=cfg.env.sync_env,
+    )
+    observation_space = envs.single_observation_space
+    action_space = envs.single_action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("SAC-AE supports only continuous (Box) action spaces")
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    state = runtime.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    encoder_def, decoder_def, actor_def, critic_def, params, target_entropy = build_agent(
+        runtime, cfg, observation_space, action_space, state["agent"] if state else None
+    )
+    optimizers = {
+        "actor": instantiate(cfg.algo.actor.optimizer),
+        "critic": instantiate(cfg.algo.critic.optimizer),
+        "alpha": instantiate(cfg.algo.alpha.optimizer),
+        "encoder": instantiate(cfg.algo.encoder.optimizer),
+        "decoder": instantiate(cfg.algo.decoder.optimizer),
+    }
+    opt_states = {
+        "actor": optimizers["actor"].init(params["actor"]),
+        "critic": optimizers["critic"].init((params["encoder"], params["critic"])),
+        "alpha": optimizers["alpha"].init(params["log_alpha"]),
+        "encoder": optimizers["encoder"].init(params["encoder"]),
+        "decoder": optimizers["decoder"].init(params["decoder"]),
+    }
+    if state and "opt_states" in state:
+        opt_states = jax.tree_util.tree_map(
+            lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
+            opt_states,
+            state["opt_states"],
+        )
+
+    train_step = make_train_step(
+        encoder_def, decoder_def, actor_def, critic_def, optimizers, cfg, target_entropy
+    )
+
+    @jax.jit
+    def policy_step(params, obs, key):
+        features = encoder_def.apply(params["encoder"], obs)
+        actions, _ = actor_def.apply(params["actor"], features, key, method="sample_and_log_prob")
+        return actions
+
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer"),
+        obs_keys=tuple(obs_keys),
+    )
+    if state and "rb" in state and state["rb"] is not None:
+        rb.load_state_dict(state["rb"])
+
+    start_iter = (state["iter_num"] if state else 0) + 1
+    policy_step_count = state["policy_step"] if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if cfg.checkpoint.resume_from:
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
+    cumulative_counter = jnp.int32(state["cumulative_counter"]) if state and "cumulative_counter" in state else jnp.int32(0)
+
+    batch_size = cfg.algo.per_rank_batch_size
+    obs, _ = envs.reset(seed=cfg.seed)
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step_count += policy_steps_per_iter
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                rng_key, step_key = jax.random.split(rng_key)
+                torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+                actions = np.asarray(policy_step(params, torch_obs, step_key))
+            next_obs, rewards, terminated, truncated, info = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, -1)
+
+        if "final_info" in info and "episode" in info["final_info"]:
+            ep = info["final_info"]["episode"]
+            mask = ep.get("_r", info["final_info"].get("_episode"))
+            if mask is not None and np.any(mask):
+                for r, l in zip(ep["r"][mask], ep["l"][mask]):
+                    aggregator.update("Rewards/rew_avg", float(r))
+                    aggregator.update("Game/ep_len_avg", float(l))
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+        if "final_obs" in info:
+            for idx, final_obs in enumerate(info["final_obs"]):
+                if final_obs is not None:
+                    for k in obs_keys:
+                        real_next_obs[k][idx] = np.asarray(final_obs[k])
+
+        step_data: Dict[str, np.ndarray] = {}
+        for k in obs_keys:
+            step_data[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[1:])
+        step_data["actions"] = actions.reshape(1, num_envs, -1)
+        step_data["rewards"] = rewards[np.newaxis]
+        step_data["terminated"] = np.asarray(terminated).reshape(1, num_envs, -1).astype(np.float32)
+        step_data["truncated"] = np.asarray(truncated).reshape(1, num_envs, -1).astype(np.float32)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        obs = next_obs
+
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step_count - prefill_steps * policy_steps_per_iter)
+            if cfg.dry_run:
+                per_rank_gradient_steps = 1
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    sample = rb.sample(
+                        batch_size=batch_size * world_size,
+                        n_samples=per_rank_gradient_steps,
+                        sample_next_obs=True,
+                    )
+                    data = {k: jnp.asarray(np.asarray(v), jnp.float32) for k, v in sample.items()}
+                    rng_key, scan_key = jax.random.split(rng_key)
+                    keys = jax.random.split(scan_key, per_rank_gradient_steps)
+                    params, opt_states, cumulative_counter, losses = train_step(
+                        params, opt_states, cumulative_counter, data, keys
+                    )
+                    losses = np.asarray(losses)
+                aggregator.update("Loss/value_loss", float(losses[0]))
+                aggregator.update("Loss/policy_loss", float(losses[1]))
+                aggregator.update("Loss/alpha_loss", float(losses[2]))
+                aggregator.update("Loss/reconstruction_loss", float(losses[3]))
+
+        if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
+            metrics = aggregator.compute()
+            timers = timer.compute()
+            if timers.get("Time/env_interaction_time", 0) > 0:
+                metrics["Time/sps_env_interaction"] = (
+                    (policy_step_count - last_log) / timers["Time/env_interaction_time"]
+                )
+            if runtime.is_global_zero:
+                logger.log_metrics(metrics, policy_step_count)
+            aggregator.reset()
+            timer.reset()
+            last_log = policy_step_count
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step_count
+            ckpt_state = {
+                "agent": jax.tree_util.tree_map(np.asarray, params),
+                "opt_states": jax.tree_util.tree_map(np.asarray, opt_states),
+                "ratio": ratio.state_dict(),
+                "cumulative_counter": int(cumulative_counter),
+                "iter_num": iter_num,
+                "policy_step": policy_step_count,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "batch_size": batch_size * world_size,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
+            runtime.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test_env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+        cumulative_rew = test(
+            encoder_def.apply, actor_def.apply, params["encoder"], params["actor"], test_env, runtime, cfg, log_dir
+        )
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, policy_step_count)
+    logger.finalize()

@@ -1,0 +1,59 @@
+"""SAC-AE helper surface (reference /root/reference/sheeprl/algos/sac_ae/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
+
+
+def preprocess_obs(obs: jax.Array, key: jax.Array, bits: int = 8) -> jax.Array:
+    """Bit-reduction + dequantization noise (reference utils.py:68-76,
+    https://arxiv.org/abs/1807.03039)."""
+    bins = 2**bits
+    if bits < 8:
+        obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    obs = obs + jax.random.uniform(key, obs.shape) / bins
+    return obs - 0.5
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
+) -> Dict[str, jax.Array]:
+    out: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        v = np.asarray(obs[k])
+        out[k] = jnp.asarray(v, jnp.float32).reshape(num_envs, -1, *v.shape[-2:]) / 255.0
+    for k in mlp_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k]), jnp.float32).reshape(num_envs, -1)
+    return out
+
+
+def test(encoder_apply, actor_apply, encoder_params, actor_params, env, runtime, cfg, log_dir: str) -> float:
+    done = False
+    cumulative_rew = 0.0
+    obs, _ = env.reset(seed=cfg.seed)
+    while not done:
+        torch_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, mlp_keys=cfg.algo.mlp_keys.encoder)
+        features = encoder_apply(encoder_params, torch_obs)
+        action = actor_apply(actor_params, features, method="greedy_action")
+        obs, reward, terminated, truncated, _ = env.step(np.asarray(action).reshape(env.action_space.shape))
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    env.close()
+    return cumulative_rew

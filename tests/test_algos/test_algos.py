@@ -281,6 +281,29 @@ def test_sac_decoupled():
     assert _checkpoint_paths(), "no checkpoint written"
 
 
+def test_sac_ae(devices):
+    _run_cli(
+        "exp=sac_ae",
+        *COMMON,
+        "dry_run=False",
+        "algo.total_steps=8",
+        "algo.run_test=False",
+        "algo.learning_starts=6",
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "env.id=continuous_dummy",
+        "env.frame_stack=1",
+        "buffer.size=64",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=16",
+        "algo.dense_units=8",
+        "algo.encoder.features_dim=8",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
 def test_unknown_algorithm_raises():
     with pytest.raises(Exception):
         _run_cli("exp=ppo", "algo.name=not_a_real_algo", "env=dummy", "fabric.accelerator=cpu")
