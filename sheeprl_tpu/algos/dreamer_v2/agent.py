@@ -1,0 +1,149 @@
+"""DreamerV2 agent (reference /root/reference/sheeprl/algos/dreamer_v2/agent.py:31-1104).
+
+Architecturally the DV3 stack (../dreamer_v3/agent.py) with the DV2 settings:
+ELU activations, no LayerNorm except in the GRU, no unimix, zero (non-learned)
+initial recurrent state, plain-scalar reward/critic heads (Normal(.,1) instead
+of two-hot), no symlog on vector inputs, default torch-style inits, and the
+`trunc_normal` continuous actor.  DV3 imports DV2's classes in the reference
+(dreamer_v3/agent.py:24-25); here the sharing points the other way — the
+parametric modules live in dreamer_v3/agent.py.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Sequence
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (  # noqa: F401
+    Actor,
+    Critic,
+    PlayerDV3,
+    WorldModel,
+    compute_stochastic_state,
+)
+
+PlayerDV2 = PlayerDV3  # same stateful env-interaction machinery (reference agent.py:735-838)
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+    target_critic_state: Optional[Dict[str, Any]] = None,
+):
+    """Returns (world_model_def, actor_def, critic_def, params)
+    (reference agent.py:841-1104)."""
+    wm_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_decoder_keys = list(cfg.algo.cnn_keys.decoder)
+    mlp_decoder_keys = list(cfg.algo.mlp_keys.decoder)
+    image_size = tuple(obs_space[cnn_keys[0]].shape[-2:]) if cnn_keys else (64, 64)
+    cnn_stages = int(np.log2(cfg.env.screen_size) - np.log2(4)) if cnn_keys else 4
+    recurrent_state_size = wm_cfg.recurrent_model.recurrent_state_size
+    stochastic_size = wm_cfg.stochastic_size
+    discrete_size = wm_cfg.discrete_size
+    latent_state_size = stochastic_size * discrete_size + recurrent_state_size
+    layer_norm = bool(cfg.algo.layer_norm)
+
+    world_model_def = WorldModel(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        cnn_decoder_keys=tuple(cnn_decoder_keys),
+        mlp_decoder_keys=tuple(mlp_decoder_keys),
+        mlp_output_dims=tuple(int(prod(obs_space[k].shape)) for k in mlp_decoder_keys),
+        cnn_input_channels=tuple(int(prod(obs_space[k].shape[:-2])) for k in cnn_decoder_keys),
+        image_size=image_size,
+        channels_multiplier=wm_cfg.encoder.cnn_channels_multiplier,
+        cnn_stages=cnn_stages,
+        encoder_dense_units=wm_cfg.encoder.dense_units,
+        encoder_mlp_layers=wm_cfg.encoder.mlp_layers,
+        decoder_dense_units=wm_cfg.observation_model.dense_units,
+        decoder_mlp_layers=wm_cfg.observation_model.mlp_layers,
+        recurrent_state_size=recurrent_state_size,
+        stochastic_size=stochastic_size,
+        discrete_size=discrete_size,
+        rssm_dense_units=wm_cfg.recurrent_model.dense_units,
+        rssm_hidden_size=wm_cfg.representation_model.hidden_size,
+        reward_dense_units=wm_cfg.reward_model.dense_units,
+        reward_mlp_layers=wm_cfg.reward_model.mlp_layers,
+        reward_bins=1,  # plain Normal(.,1) scalar head (reference dreamer_v2.py:170)
+        continue_dense_units=wm_cfg.discount_model.dense_units,
+        continue_mlp_layers=wm_cfg.discount_model.mlp_layers,
+        unimix=0.0,
+        eps=1e-5,
+        learnable_initial_recurrent_state=False,
+        decoupled_rssm=False,
+        dense_act="elu",
+        cnn_act="elu",
+        layer_norm=layer_norm,
+        gru_layer_norm=bool(wm_cfg.recurrent_model.layer_norm),
+        symlog_inputs=False,
+        hafner_heads=False,
+    )
+    actor_def = Actor(
+        latent_state_size=latent_state_size,
+        actions_dim=tuple(int(a) for a in actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.type,
+        init_std=actor_cfg.init_std,
+        min_std=actor_cfg.min_std,
+        dense_units=actor_cfg.dense_units,
+        mlp_layers=actor_cfg.mlp_layers,
+        unimix=0.0,
+        action_clip=1.0,
+        eps=1e-5,
+        dense_act="elu",
+        layer_norm=layer_norm,
+        default_continuous_dist="trunc_normal",
+    )
+    critic_def = Critic(
+        dense_units=critic_cfg.dense_units,
+        mlp_layers=critic_cfg.mlp_layers,
+        bins=1,
+        eps=1e-5,
+        act="elu",
+        layer_norm=layer_norm,
+        zero_init_head=False,
+    )
+
+    key = jax.random.PRNGKey(int(cfg.seed or 0))
+    k_wm, k_actor, k_critic, k_call = jax.random.split(key, 4)
+    sample_obs: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        sample_obs[k] = jnp.zeros((1,) + tuple(obs_space[k].shape), jnp.float32)
+    for k in mlp_keys:
+        sample_obs[k] = jnp.zeros((1, int(prod(obs_space[k].shape))), jnp.float32)
+    sample_action = jnp.zeros((1, int(sum(actions_dim))), jnp.float32)
+    sample_is_first = jnp.ones((1, 1), jnp.float32)
+    wm_params = world_model_def.init(k_wm, sample_obs, sample_action, sample_is_first, k_call)
+    sample_latent = jnp.zeros((1, latent_state_size), jnp.float32)
+    actor_params = actor_def.init(k_actor, sample_latent)
+    critic_params = critic_def.init(k_critic, sample_latent)
+    params = {
+        "world_model": wm_params,
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
+    }
+    if world_model_state is not None:
+        params["world_model"] = jax.tree_util.tree_map(jnp.asarray, world_model_state)
+    if actor_state is not None:
+        params["actor"] = jax.tree_util.tree_map(jnp.asarray, actor_state)
+    if critic_state is not None:
+        params["critic"] = jax.tree_util.tree_map(jnp.asarray, critic_state)
+    if target_critic_state is not None:
+        params["target_critic"] = jax.tree_util.tree_map(jnp.asarray, target_critic_state)
+    return world_model_def, actor_def, critic_def, params

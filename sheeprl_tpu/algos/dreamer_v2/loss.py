@@ -1,0 +1,55 @@
+"""DreamerV2 world-model loss (reference /root/reference/sheeprl/algos/dreamer_v2/loss.py):
+Normal(.,1) observation/reward log-probs, alpha-form KL balancing (0.8) with
+free-nats applied to the batch mean (``kl_free_avg``)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.ops.distributions import Bernoulli, kl_categorical
+
+
+def normal_log_prob(mean: jax.Array, value: jax.Array, event_dims: int) -> jax.Array:
+    """Independent(Normal(mean, 1)) log-prob summed over trailing event dims."""
+    lp = -0.5 * (value - mean) ** 2 - 0.5 * jnp.log(2 * jnp.pi)
+    return jnp.sum(lp, axis=tuple(range(-event_dims, 0)))
+
+
+def reconstruction_loss(
+    recon: Dict[str, jax.Array],
+    observations: Dict[str, jax.Array],
+    reward_mean: jax.Array,
+    rewards: jax.Array,
+    priors_logits: jax.Array,
+    posteriors_logits: jax.Array,
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 1.0,
+    kl_free_avg: bool = True,
+    kl_regularizer: float = 1.0,
+    pc: Optional[Bernoulli] = None,
+    continue_targets: Optional[jax.Array] = None,
+    discount_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, ...]:
+    observation_loss = -sum(
+        jnp.mean(normal_log_prob(recon[k], observations[k], len(recon[k].shape[2:]))) for k in recon
+    )
+    reward_loss = -jnp.mean(normal_log_prob(reward_mean, rewards, 1))
+    lhs = kl = kl_categorical(jax.lax.stop_gradient(posteriors_logits), priors_logits, event_dims=1)
+    rhs = kl_categorical(posteriors_logits, jax.lax.stop_gradient(priors_logits), event_dims=1)
+    if kl_free_avg:
+        lhs_m, rhs_m = jnp.mean(lhs), jnp.mean(rhs)
+        loss_lhs = jnp.maximum(lhs_m, kl_free_nats)
+        loss_rhs = jnp.maximum(rhs_m, kl_free_nats)
+    else:
+        loss_lhs = jnp.mean(jnp.maximum(lhs, kl_free_nats))
+        loss_rhs = jnp.mean(jnp.maximum(rhs, kl_free_nats))
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+    if pc is not None and continue_targets is not None:
+        continue_loss = discount_scale_factor * -jnp.mean(pc.log_prob(continue_targets))
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss
+    return rec_loss, jnp.mean(kl), kl_loss, reward_loss, observation_loss, continue_loss

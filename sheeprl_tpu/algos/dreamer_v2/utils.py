@@ -1,0 +1,54 @@
+"""DreamerV2 helpers (reference /root/reference/sheeprl/algos/dreamer_v2/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401  (same obs/test machinery)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    bootstrap: jax.Array | None = None,
+    horizon: int = 15,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """DV2-style lambda returns with explicit bootstrap (reference
+    utils.py:85-102) as a reverse scan."""
+    if bootstrap is None:
+        bootstrap = jnp.zeros_like(values[-1:])
+    next_values = jnp.concatenate([values[1:], bootstrap], axis=0)
+    inputs = rewards + continues * next_values * (1 - lmbda)
+
+    def body(agg, inp):
+        inp_t, cont_t = inp
+        agg = inp_t + cont_t * lmbda * agg
+        return agg, agg
+
+    _, lv = jax.lax.scan(body, bootstrap[0], (inputs, continues), reverse=True)
+    return lv

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from sheeprl_tpu.models.blocks import LayerNormGRUCell
+from sheeprl_tpu.models.blocks import LayerNormGRUCell, get_activation
 from sheeprl_tpu.ops.numerics import symlog
 
 # Hafner initializers (reference algos/dreamer_v3/utils.py:143-188)
@@ -42,19 +42,25 @@ def uniform_init(scale: float):
 
 
 class DenseStack(nn.Module):
-    """[Dense(no bias) → LayerNorm(eps) → act] × layers
-    (the reference's MLP(…, bias=False, norm_layer=LayerNorm), agent.py:100-151)."""
+    """[Dense(no bias iff LN) → LayerNorm(eps)? → act] × layers
+    (the reference's MLP(…, bias=False, norm_layer=LayerNorm), agent.py:100-151).
+    ``act``/``layer_norm`` are parametric so DreamerV2/V1 (ELU, no LN) reuse
+    the same stack."""
 
     units: int
     layers: int
     eps: float = 1e-3
+    act: str = "silu"
+    layer_norm: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        fn = get_activation(self.act)
         for _ in range(self.layers):
-            x = nn.Dense(self.units, use_bias=False, kernel_init=trunc_normal_init)(x)
-            x = nn.LayerNorm(epsilon=self.eps)(x)
-            x = jax.nn.silu(x)
+            x = nn.Dense(self.units, use_bias=not self.layer_norm, kernel_init=trunc_normal_init)(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(epsilon=self.eps)(x)
+            x = fn(x)
         return x
 
 
@@ -66,9 +72,12 @@ class CNNEncoderDV3(nn.Module):
     channels_multiplier: int
     stages: int = 4
     eps: float = 1e-3
+    act: str = "silu"
+    layer_norm: bool = True
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        fn = get_activation(self.act)
         x = jnp.concatenate([obs[k] for k in self.keys], axis=-3)
         lead = x.shape[:-3]
         x = x.reshape((-1,) + x.shape[-3:])
@@ -79,11 +88,12 @@ class CNNEncoderDV3(nn.Module):
                 (4, 4),
                 strides=(2, 2),
                 padding=((1, 1), (1, 1)),
-                use_bias=False,
+                use_bias=not self.layer_norm,
                 kernel_init=trunc_normal_init,
             )(x)
-            x = nn.LayerNorm(epsilon=self.eps)(x)  # channel-last LN: native in NHWC
-            x = jax.nn.silu(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(epsilon=self.eps)(x)  # channel-last LN: native in NHWC
+            x = fn(x)
         return x.reshape(lead + (-1,))
 
 
@@ -95,11 +105,13 @@ class MLPEncoderDV3(nn.Module):
     mlp_layers: int
     eps: float = 1e-3
     symlog_inputs: bool = True
+    act: str = "silu"
+    layer_norm: bool = True
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
         x = jnp.concatenate([symlog(obs[k]) if self.symlog_inputs else obs[k] for k in self.keys], axis=-1)
-        return DenseStack(self.dense_units, self.mlp_layers, self.eps)(x)
+        return DenseStack(self.dense_units, self.mlp_layers, self.eps, self.act, self.layer_norm)(x)
 
 
 class CNNDecoderDV3(nn.Module):
@@ -112,9 +124,12 @@ class CNNDecoderDV3(nn.Module):
     image_size: Tuple[int, int]
     stages: int = 4
     eps: float = 1e-3
+    act: str = "silu"
+    layer_norm: bool = True
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> jax.Array:
+        fn = get_activation(self.act)
         lead = latent.shape[:-1]
         start = self.image_size[0] // (2**self.stages)
         top_channels = (2 ** (self.stages - 1)) * self.channels_multiplier
@@ -128,11 +143,12 @@ class CNNDecoderDV3(nn.Module):
                 (4, 4),
                 strides=(2, 2),
                 padding="SAME",
-                use_bias=False,
+                use_bias=not self.layer_norm,
                 kernel_init=trunc_normal_init,
             )(x)
-            x = nn.LayerNorm(epsilon=self.eps)(x)
-            x = jax.nn.silu(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(epsilon=self.eps)(x)
+            x = fn(x)
         x = nn.ConvTranspose(
             self.total_channels, (4, 4), strides=(2, 2), padding="SAME", kernel_init=uniform_init(1.0)
         )(x)
@@ -148,10 +164,12 @@ class MLPDecoderDV3(nn.Module):
     dense_units: int
     mlp_layers: int
     eps: float = 1e-3
+    act: str = "silu"
+    layer_norm: bool = True
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
-        x = DenseStack(self.dense_units, self.mlp_layers, self.eps)(latent)
+        x = DenseStack(self.dense_units, self.mlp_layers, self.eps, self.act, self.layer_norm)(latent)
         return {
             k: nn.Dense(d, kernel_init=uniform_init(1.0))(x) for k, d in zip(self.keys, self.output_dims)
         }
@@ -163,12 +181,18 @@ class RecurrentModel(nn.Module):
     recurrent_state_size: int
     dense_units: int
     eps: float = 1e-3
+    act: str = "silu"
+    layer_norm: bool = True
+    gru_layer_norm: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
-        feat = DenseStack(self.dense_units, 1, self.eps)(x)
+        feat = DenseStack(self.dense_units, 1, self.eps, self.act, self.layer_norm)(x)
         return LayerNormGRUCell(
-            hidden_size=self.recurrent_state_size, use_bias=False, layer_norm=True, norm_eps=self.eps
+            hidden_size=self.recurrent_state_size,
+            use_bias=not self.gru_layer_norm,
+            layer_norm=self.gru_layer_norm,
+            norm_eps=self.eps,
         )(recurrent_state, feat)
 
 
@@ -217,14 +241,28 @@ class RSSM(nn.Module):
     eps: float = 1e-3
     learnable_initial_recurrent_state: bool = True
     decoupled: bool = False
+    act: str = "silu"
+    layer_norm: bool = True
+    gru_layer_norm: bool = True
+    head_scale: float = 1.0
+    tanh_initial_state: bool = True
 
     def setup(self) -> None:
         self.recurrent_model = RecurrentModel(
-            recurrent_state_size=self.recurrent_state_size, dense_units=self.dense_units, eps=self.eps
+            recurrent_state_size=self.recurrent_state_size,
+            dense_units=self.dense_units,
+            eps=self.eps,
+            act=self.act,
+            layer_norm=self.layer_norm,
+            gru_layer_norm=self.gru_layer_norm,
         )
         stoch_flat = self.stochastic_size * self.discrete_size
-        self.representation_model = _StochHead(self.hidden_size, stoch_flat, self.eps)
-        self.transition_model = _StochHead(self.hidden_size, stoch_flat, self.eps)
+        self.representation_model = _StochHead(
+            self.hidden_size, stoch_flat, self.eps, self.act, self.layer_norm, self.head_scale
+        )
+        self.transition_model = _StochHead(
+            self.hidden_size, stoch_flat, self.eps, self.act, self.layer_norm, self.head_scale
+        )
         if self.learnable_initial_recurrent_state:
             self.initial_recurrent_state = self.param(
                 "initial_recurrent_state", nn.initializers.zeros, (self.recurrent_state_size,)
@@ -237,7 +275,7 @@ class RSSM(nn.Module):
         return self.dynamic(posterior, recurrent_state, action, embedded_obs, is_first, key)
 
     def get_initial_states(self, batch_shape: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
-        h0 = jnp.tanh(self.initial_recurrent_state)
+        h0 = jnp.tanh(self.initial_recurrent_state) if self.tanh_initial_state else self.initial_recurrent_state
         h0 = jnp.broadcast_to(h0, tuple(batch_shape) + h0.shape)
         logits = self.transition_model(h0)
         logits = _unimix(logits, self.discrete_size, self.unimix)
@@ -288,11 +326,15 @@ class _StochHead(nn.Module):
     hidden_size: int
     out_size: int
     eps: float = 1e-3
+    act: str = "silu"
+    layer_norm: bool = True
+    head_scale: float = 1.0
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = DenseStack(self.hidden_size, 1, self.eps)(x)
-        return nn.Dense(self.out_size, kernel_init=uniform_init(1.0))(x)
+        x = DenseStack(self.hidden_size, 1, self.eps, self.act, self.layer_norm)(x)
+        init = uniform_init(self.head_scale) if self.head_scale != -1 else trunc_normal_init
+        return nn.Dense(self.out_size, kernel_init=init)(x)
 
 
 class PredictionHead(nn.Module):
@@ -304,11 +346,14 @@ class PredictionHead(nn.Module):
     out_dim: int
     head_scale: float = 0.0
     eps: float = 1e-3
+    act: str = "silu"
+    layer_norm: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = DenseStack(self.dense_units, self.mlp_layers, self.eps)(x)
-        return nn.Dense(self.out_dim, kernel_init=uniform_init(self.head_scale))(x)
+        x = DenseStack(self.dense_units, self.mlp_layers, self.eps, self.act, self.layer_norm)(x)
+        init = uniform_init(self.head_scale) if self.head_scale != -1 else trunc_normal_init
+        return nn.Dense(self.out_dim, kernel_init=init)(x)
 
 
 class WorldModel(nn.Module):
@@ -343,6 +388,12 @@ class WorldModel(nn.Module):
     eps: float = 1e-3
     learnable_initial_recurrent_state: bool = True
     decoupled_rssm: bool = False
+    dense_act: str = "silu"
+    cnn_act: str = "silu"
+    layer_norm: bool = True
+    gru_layer_norm: bool = True
+    symlog_inputs: bool = True
+    hafner_heads: bool = True  # uniform/zero head inits (DV3); -1 sentinel = default init
 
     def setup(self) -> None:
         self.cnn_encoder = (
@@ -351,6 +402,8 @@ class WorldModel(nn.Module):
                 channels_multiplier=self.channels_multiplier,
                 stages=self.cnn_stages,
                 eps=self.eps,
+                act=self.cnn_act,
+                layer_norm=self.layer_norm,
             )
             if self.cnn_keys
             else None
@@ -361,6 +414,9 @@ class WorldModel(nn.Module):
                 dense_units=self.encoder_dense_units,
                 mlp_layers=self.encoder_mlp_layers,
                 eps=self.eps,
+                symlog_inputs=self.symlog_inputs,
+                act=self.dense_act,
+                layer_norm=self.layer_norm,
             )
             if self.mlp_keys
             else None
@@ -383,6 +439,11 @@ class WorldModel(nn.Module):
             eps=self.eps,
             learnable_initial_recurrent_state=self.learnable_initial_recurrent_state,
             decoupled=self.decoupled_rssm,
+            act=self.dense_act,
+            layer_norm=self.layer_norm,
+            gru_layer_norm=self.gru_layer_norm,
+            head_scale=1.0 if self.hafner_heads else -1,
+            tanh_initial_state=self.learnable_initial_recurrent_state,
         )
         self.cnn_decoder = (
             CNNDecoderDV3(
@@ -391,6 +452,8 @@ class WorldModel(nn.Module):
                 image_size=tuple(self.image_size),
                 stages=self.cnn_stages,
                 eps=self.eps,
+                act=self.cnn_act,
+                layer_norm=self.layer_norm,
             )
             if self.cnn_decoder_keys
             else None
@@ -402,15 +465,29 @@ class WorldModel(nn.Module):
                 dense_units=self.decoder_dense_units,
                 mlp_layers=self.decoder_mlp_layers,
                 eps=self.eps,
+                act=self.dense_act,
+                layer_norm=self.layer_norm,
             )
             if self.mlp_decoder_keys
             else None
         )
         self.reward_model = PredictionHead(
-            self.reward_dense_units, self.reward_mlp_layers, self.reward_bins, head_scale=0.0, eps=self.eps
+            self.reward_dense_units,
+            self.reward_mlp_layers,
+            self.reward_bins,
+            head_scale=0.0 if self.hafner_heads else -1,
+            eps=self.eps,
+            act=self.dense_act,
+            layer_norm=self.layer_norm,
         )
         self.continue_model = PredictionHead(
-            self.continue_dense_units, self.continue_mlp_layers, 1, head_scale=1.0, eps=self.eps
+            self.continue_dense_units,
+            self.continue_mlp_layers,
+            1,
+            head_scale=1.0 if self.hafner_heads else -1,
+            eps=self.eps,
+            act=self.dense_act,
+            layer_norm=self.layer_norm,
         )
 
     # -- init path ----------------------------------------------------------
@@ -491,15 +568,18 @@ class Actor(nn.Module):
     unimix: float = 0.01
     action_clip: float = 1.0
     eps: float = 1e-3
+    dense_act: str = "silu"
+    layer_norm: bool = True
+    default_continuous_dist: str = "scaled_normal"  # DV2/DV1 use trunc_normal/tanh_normal
 
     def setup(self) -> None:
         dist = self.distribution.lower()
-        if dist not in ("auto", "normal", "tanh_normal", "discrete", "scaled_normal"):
+        if dist not in ("auto", "normal", "tanh_normal", "discrete", "scaled_normal", "trunc_normal"):
             raise ValueError(f"Invalid actor distribution: {dist}")
         if dist == "auto":
-            dist = "scaled_normal" if self.is_continuous else "discrete"
+            dist = self.default_continuous_dist if self.is_continuous else "discrete"
         self.dist = dist
-        self.model = DenseStack(self.dense_units, self.mlp_layers, self.eps)
+        self.model = DenseStack(self.dense_units, self.mlp_layers, self.eps, self.dense_act, self.layer_norm)
         if self.is_continuous:
             self.heads = [nn.Dense(int(sum(self.actions_dim)) * 2, kernel_init=uniform_init(1.0))]
         else:
@@ -518,6 +598,10 @@ class Actor(nn.Module):
         elif self.dist == "scaled_normal":
             std = (self.max_std - self.min_std) * jax.nn.sigmoid(std + self.init_std) + self.min_std
             mean = jnp.tanh(mean)
+        elif self.dist == "trunc_normal":
+            # DreamerV2 continuous actor (reference dreamer_v2/agent.py:536-539)
+            std = 2 * jax.nn.sigmoid((std + self.init_std) / 2) + self.min_std
+            mean = jnp.tanh(mean)
         return mean, std
 
     def act(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False) -> jax.Array:
@@ -531,7 +615,12 @@ class Actor(nn.Module):
                 # and deterministic
                 actions = mean
             else:
-                actions = mean + std * jax.random.normal(key, mean.shape)
+                if self.dist == "trunc_normal":
+                    from sheeprl_tpu.ops.distributions import TruncatedNormal
+
+                    actions = TruncatedNormal(mean, std, -1.0, 1.0).rsample(key)
+                else:
+                    actions = mean + std * jax.random.normal(key, mean.shape)
             if self.dist == "tanh_normal":
                 actions = jnp.tanh(actions)
             if self.action_clip > 0.0:
@@ -569,6 +658,11 @@ class Actor(nn.Module):
                 log_prob = jnp.sum(lp, axis=-1, keepdims=True)
                 ent = -log_prob  # no closed form for tanh-normal entropy
                 return log_prob, ent
+            if self.dist == "trunc_normal":
+                from sheeprl_tpu.ops.distributions import TruncatedNormal
+
+                d = TruncatedNormal(mean, std, -1.0, 1.0, event_dims=1)
+                return d.log_prob(actions)[..., None], d.entropy()[..., None]
             var = std**2
             lp = -((actions - mean) ** 2) / (2 * var) - jnp.log(std) - 0.5 * jnp.log(2 * jnp.pi)
             log_prob = jnp.sum(lp, axis=-1, keepdims=True)
@@ -600,11 +694,15 @@ class Critic(nn.Module):
     mlp_layers: int
     bins: int = 255
     eps: float = 1e-3
+    act: str = "silu"
+    layer_norm: bool = True
+    zero_init_head: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = DenseStack(self.dense_units, self.mlp_layers, self.eps)(x)
-        return nn.Dense(self.bins, kernel_init=uniform_init(0.0))(x)
+        x = DenseStack(self.dense_units, self.mlp_layers, self.eps, self.act, self.layer_norm)(x)
+        init = uniform_init(0.0) if self.zero_init_head else trunc_normal_init
+        return nn.Dense(self.bins, kernel_init=init)(x)
 
 
 def build_agent(
