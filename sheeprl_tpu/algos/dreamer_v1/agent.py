@@ -1,0 +1,371 @@
+"""DreamerV1 agent (reference /root/reference/sheeprl/algos/dreamer_v1/agent.py:64-547).
+
+DV1's latent state is a **continuous diagonal Gaussian** (stochastic_size=30):
+the representation/transition heads emit (mean, std) with
+``std = softplus(raw) + min_std`` and the state is a reparameterized sample
+(reference utils.py:80-108).  Encoder/decoder/actor/critic reuse the
+parametric DV3 blocks with ELU/ReLU activations and no LayerNorm; the actor's
+continuous distribution defaults to ``tanh_normal``.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    Actor,
+    CNNDecoderDV3,
+    CNNEncoderDV3,
+    Critic,
+    DenseStack,
+    MLPDecoderDV3,
+    MLPEncoderDV3,
+    PlayerDV3,
+    RecurrentModel,
+    trunc_normal_init,
+)
+
+PlayerDV1 = PlayerDV3
+
+
+def gaussian_state(raw: jax.Array, key: Optional[jax.Array], min_std: float = 0.1, sample: bool = True):
+    """(mean, std), rsample — reference dreamer_v1/utils.py:80-108."""
+    mean, std = jnp.split(raw, 2, axis=-1)
+    std = jax.nn.softplus(std) + min_std
+    if sample:
+        state = mean + std * jax.random.normal(key, mean.shape)
+    else:
+        state = mean
+    return (mean, std), state
+
+
+class GaussianRSSM(nn.Module):
+    """Continuous-latent RSSM (reference agent.py:64-191).  No is_first
+    resets: DV1's dynamic takes only (posterior, recurrent, action, embed)."""
+
+    recurrent_state_size: int
+    stochastic_size: int
+    dense_units: int
+    hidden_size: int
+    min_std: float = 0.1
+    act: str = "elu"
+
+    def setup(self) -> None:
+        self.recurrent_model = RecurrentModel(
+            recurrent_state_size=self.recurrent_state_size,
+            dense_units=self.dense_units,
+            act=self.act,
+            layer_norm=False,
+            gru_layer_norm=False,
+        )
+        self.representation_model = _GaussHead(self.hidden_size, self.stochastic_size * 2, self.act)
+        self.transition_model = _GaussHead(self.hidden_size, self.stochastic_size * 2, self.act)
+
+    def __call__(self, posterior, recurrent_state, action, embedded_obs, key):
+        return self.dynamic(posterior, recurrent_state, action, embedded_obs, key)
+
+    def get_initial_states(self, batch_shape: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
+        h0 = jnp.zeros(tuple(batch_shape) + (self.recurrent_state_size,))
+        z0 = jnp.zeros(tuple(batch_shape) + (self.stochastic_size,))
+        return h0, z0
+
+    def _representation(self, recurrent_state, embedded_obs, key):
+        raw = self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], axis=-1))
+        return gaussian_state(raw, key, self.min_std)
+
+    def _transition(self, recurrent_out, key, sample_state: bool = True):
+        raw = self.transition_model(recurrent_out)
+        return gaussian_state(raw, key, self.min_std, sample=sample_state)
+
+    def dynamic(self, posterior, recurrent_state, action, embedded_obs, key):
+        """Reference agent.py:97-135: returns (recurrent, posterior, prior,
+        posterior_mean_std, prior_mean_std)."""
+        k1, k2 = jax.random.split(key)
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], axis=-1), recurrent_state
+        )
+        prior_mean_std, prior = self._transition(recurrent_state, k1)
+        posterior_mean_std, posterior = self._representation(recurrent_state, embedded_obs, k2)
+        return recurrent_state, posterior, prior, posterior_mean_std, prior_mean_std
+
+    def imagination(self, stochastic_state, recurrent_state, actions, key):
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([stochastic_state, actions], axis=-1), recurrent_state
+        )
+        _, imagined_prior = self._transition(recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+
+class _GaussHead(nn.Module):
+    hidden_size: int
+    out_size: int
+    act: str = "elu"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = DenseStack(self.hidden_size, 1, act=self.act, layer_norm=False)(x)
+        return nn.Dense(self.out_size, kernel_init=trunc_normal_init)(x)
+
+
+class WorldModelDV1(nn.Module):
+    """Encoder + GaussianRSSM + decoders + reward (+ continue) as one tree
+    (reference agent.py:194-263 + build_agent :330-547)."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_decoder_keys: Sequence[str]
+    mlp_decoder_keys: Sequence[str]
+    mlp_output_dims: Sequence[int]
+    cnn_input_channels: Sequence[int]
+    image_size: Tuple[int, int]
+    channels_multiplier: int
+    cnn_stages: int
+    encoder_dense_units: int
+    encoder_mlp_layers: int
+    decoder_dense_units: int
+    decoder_mlp_layers: int
+    recurrent_state_size: int
+    stochastic_size: int
+    rssm_dense_units: int
+    rssm_hidden_size: int
+    reward_dense_units: int
+    reward_mlp_layers: int
+    continue_dense_units: int
+    continue_mlp_layers: int
+    min_std: float = 0.1
+    dense_act: str = "elu"
+    cnn_act: str = "relu"
+
+    # kept for PlayerDV3 compatibility
+    discrete_size: int = 1
+    decoupled_rssm: bool = False
+
+    def setup(self) -> None:
+        self.cnn_encoder = (
+            CNNEncoderDV3(
+                keys=tuple(self.cnn_keys),
+                channels_multiplier=self.channels_multiplier,
+                stages=self.cnn_stages,
+                act=self.cnn_act,
+                layer_norm=False,
+            )
+            if self.cnn_keys
+            else None
+        )
+        self.mlp_encoder = (
+            MLPEncoderDV3(
+                keys=tuple(self.mlp_keys),
+                dense_units=self.encoder_dense_units,
+                mlp_layers=self.encoder_mlp_layers,
+                symlog_inputs=False,
+                act=self.dense_act,
+                layer_norm=False,
+            )
+            if self.mlp_keys
+            else None
+        )
+        self.rssm = GaussianRSSM(
+            recurrent_state_size=self.recurrent_state_size,
+            stochastic_size=self.stochastic_size,
+            dense_units=self.rssm_dense_units,
+            hidden_size=self.rssm_hidden_size,
+            min_std=self.min_std,
+            act=self.dense_act,
+        )
+        self.cnn_decoder = (
+            CNNDecoderDV3(
+                total_channels=int(sum(self.cnn_input_channels)),
+                channels_multiplier=self.channels_multiplier,
+                image_size=tuple(self.image_size),
+                stages=self.cnn_stages,
+                act=self.cnn_act,
+                layer_norm=False,
+            )
+            if self.cnn_decoder_keys
+            else None
+        )
+        self.mlp_decoder = (
+            MLPDecoderDV3(
+                keys=tuple(self.mlp_decoder_keys),
+                output_dims=tuple(self.mlp_output_dims),
+                dense_units=self.decoder_dense_units,
+                mlp_layers=self.decoder_mlp_layers,
+                act=self.dense_act,
+                layer_norm=False,
+            )
+            if self.mlp_decoder_keys
+            else None
+        )
+        self.reward_model = _GaussHeadStack(
+            self.reward_dense_units, self.reward_mlp_layers, 1, self.dense_act
+        )
+        self.continue_model = _GaussHeadStack(
+            self.continue_dense_units, self.continue_mlp_layers, 1, self.dense_act
+        )
+
+    def __call__(self, obs, action, is_first, key):
+        del is_first  # DV1 has no is_first resets
+        embedded = self.encode(obs)
+        batch_shape = action.shape[:-1]
+        posterior = jnp.zeros(batch_shape + (self.stochastic_size,))
+        recurrent = jnp.zeros(batch_shape + (self.recurrent_state_size,))
+        recurrent, posterior, prior, _, _ = self.rssm.dynamic(posterior, recurrent, action, embedded, key)
+        latent = jnp.concatenate([posterior, recurrent], axis=-1)
+        return self.decode(latent), self.reward_model(latent), self.continue_model(latent)
+
+    def encode(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        feats = []
+        if self.cnn_encoder is not None:
+            feats.append(self.cnn_encoder(obs))
+        if self.mlp_encoder is not None:
+            feats.append(self.mlp_encoder(obs))
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+    def decode(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_decoder is not None:
+            recon = self.cnn_decoder(latent)
+            start = 0
+            for k, c in zip(self.cnn_decoder_keys, self.cnn_input_channels):
+                out[k] = recon[..., start : start + c, :, :]
+                start += c
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(latent))
+        return out
+
+    def reward_logits(self, latent: jax.Array) -> jax.Array:
+        return self.reward_model(latent)
+
+    def continue_logits(self, latent: jax.Array) -> jax.Array:
+        return self.continue_model(latent)
+
+    def dynamic(self, posterior, recurrent_state, action, embedded_obs, key):
+        return self.rssm.dynamic(posterior, recurrent_state, action, embedded_obs, key)
+
+    def imagination(self, prior, recurrent_state, actions, key):
+        return self.rssm.imagination(prior, recurrent_state, actions, key)
+
+    def initial_states(self, batch_shape: Sequence[int]):
+        return self.rssm.get_initial_states(batch_shape)
+
+    def representation(self, recurrent_state, embedded_obs, key):
+        # PlayerDV3 expects (logits, state); return mean/std tuple in slot 0
+        mean_std, state = self.rssm._representation(recurrent_state, embedded_obs, key)
+        return mean_std, state
+
+    def recurrent_step(self, stochastic, actions, recurrent_state):
+        return self.rssm.recurrent_model(
+            jnp.concatenate([stochastic, actions], axis=-1), recurrent_state
+        )
+
+
+class _GaussHeadStack(nn.Module):
+    dense_units: int
+    mlp_layers: int
+    out_dim: int
+    act: str = "elu"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = DenseStack(self.dense_units, self.mlp_layers, act=self.act, layer_norm=False)(x)
+        return nn.Dense(self.out_dim, kernel_init=trunc_normal_init)(x)
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+):
+    """Returns (world_model_def, actor_def, critic_def, params)
+    (reference agent.py:330-547; no target critic in DV1)."""
+    wm_cfg = cfg.algo.world_model
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_decoder_keys = list(cfg.algo.cnn_keys.decoder)
+    mlp_decoder_keys = list(cfg.algo.mlp_keys.decoder)
+    image_size = tuple(obs_space[cnn_keys[0]].shape[-2:]) if cnn_keys else (64, 64)
+    cnn_stages = int(np.log2(cfg.env.screen_size) - np.log2(4)) if cnn_keys else 4
+    latent_state_size = wm_cfg.stochastic_size + wm_cfg.recurrent_model.recurrent_state_size
+
+    world_model_def = WorldModelDV1(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        cnn_decoder_keys=tuple(cnn_decoder_keys),
+        mlp_decoder_keys=tuple(mlp_decoder_keys),
+        mlp_output_dims=tuple(int(prod(obs_space[k].shape)) for k in mlp_decoder_keys),
+        cnn_input_channels=tuple(int(prod(obs_space[k].shape[:-2])) for k in cnn_decoder_keys),
+        image_size=image_size,
+        channels_multiplier=wm_cfg.encoder.cnn_channels_multiplier,
+        cnn_stages=cnn_stages,
+        encoder_dense_units=wm_cfg.encoder.dense_units,
+        encoder_mlp_layers=wm_cfg.encoder.mlp_layers,
+        decoder_dense_units=wm_cfg.observation_model.dense_units,
+        decoder_mlp_layers=wm_cfg.observation_model.mlp_layers,
+        recurrent_state_size=wm_cfg.recurrent_model.recurrent_state_size,
+        stochastic_size=wm_cfg.stochastic_size,
+        rssm_dense_units=wm_cfg.recurrent_model.dense_units,
+        rssm_hidden_size=wm_cfg.representation_model.hidden_size,
+        reward_dense_units=wm_cfg.reward_model.dense_units,
+        reward_mlp_layers=wm_cfg.reward_model.mlp_layers,
+        continue_dense_units=wm_cfg.discount_model.dense_units,
+        continue_mlp_layers=wm_cfg.discount_model.mlp_layers,
+        min_std=wm_cfg.min_std,
+        dense_act="elu",
+        cnn_act="relu",
+    )
+    actor_def = Actor(
+        latent_state_size=latent_state_size,
+        actions_dim=tuple(int(a) for a in actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.type,
+        init_std=cfg.algo.actor.init_std,
+        min_std=cfg.algo.actor.min_std,
+        dense_units=cfg.algo.actor.dense_units,
+        mlp_layers=cfg.algo.actor.mlp_layers,
+        unimix=0.0,
+        action_clip=1.0,
+        dense_act="elu",
+        layer_norm=False,
+        default_continuous_dist="tanh_normal",
+    )
+    critic_def = Critic(
+        dense_units=cfg.algo.critic.dense_units,
+        mlp_layers=cfg.algo.critic.mlp_layers,
+        bins=1,
+        act="elu",
+        layer_norm=False,
+        zero_init_head=False,
+    )
+
+    key = jax.random.PRNGKey(int(cfg.seed or 0))
+    k_wm, k_actor, k_critic, k_call = jax.random.split(key, 4)
+    sample_obs: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        sample_obs[k] = jnp.zeros((1,) + tuple(obs_space[k].shape), jnp.float32)
+    for k in mlp_keys:
+        sample_obs[k] = jnp.zeros((1, int(prod(obs_space[k].shape))), jnp.float32)
+    sample_action = jnp.zeros((1, int(sum(actions_dim))), jnp.float32)
+    wm_params = world_model_def.init(k_wm, sample_obs, sample_action, None, k_call)
+    sample_latent = jnp.zeros((1, latent_state_size), jnp.float32)
+    actor_params = actor_def.init(k_actor, sample_latent)
+    critic_params = critic_def.init(k_critic, sample_latent)
+    params = {"world_model": wm_params, "actor": actor_params, "critic": critic_params}
+    if world_model_state is not None:
+        params["world_model"] = jax.tree_util.tree_map(jnp.asarray, world_model_state)
+    if actor_state is not None:
+        params["actor"] = jax.tree_util.tree_map(jnp.asarray, actor_state)
+    if critic_state is not None:
+        params["critic"] = jax.tree_util.tree_map(jnp.asarray, critic_state)
+    return world_model_def, actor_def, critic_def, params

@@ -1,0 +1,505 @@
+"""DreamerV1 training loop — TPU-native re-design of
+/root/reference/sheeprl/algos/dreamer_v1/dreamer_v1.py:46-750.
+
+Same jitted-graph shape as DV3/DV2; DV1-specific math: Gaussian latents with
+Normal-KL free nats, pure dynamics-backprop actor loss
+(``-mean(discount * lambda_values)``), Normal(.,1) critic on ``horizon-1``
+lambda targets, and no target critic.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.dreamer_v1.agent import PlayerDV1, build_agent
+from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v1.utils import (  # noqa: F401
+    AGGREGATOR_KEYS,
+    MODELS_TO_REGISTER,
+    compute_lambda_values,
+    prepare_obs,
+    test,
+)
+from sheeprl_tpu.algos.dreamer_v2.loss import normal_log_prob
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.ops.distributions import Bernoulli
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+METRIC_ORDER = [
+    "Loss/world_model_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "Loss/policy_loss",
+    "Loss/value_loss",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+]
+
+
+def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg):
+    wm_cfg = cfg.algo.world_model
+    stochastic_size = wm_cfg.stochastic_size
+    recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
+    horizon = cfg.algo.horizon
+    gamma = cfg.algo.gamma
+    cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
+    use_continues = wm_cfg.use_continues
+
+    def train_step(params, opt_states, batch, key):
+        T, B = batch["actions"].shape[:2]
+        k_wm, k_img = jax.random.split(key)
+        batch_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}
+
+        def wm_loss_fn(wm_params):
+            embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
+
+            def scan_body(carry, x):
+                posterior, recurrent = carry
+                action_t, embed_t, key_t = x
+                recurrent, posterior, _, post_ms, prior_ms = world_model_def.apply(
+                    wm_params, posterior, recurrent, action_t, embed_t, key_t, method="dynamic"
+                )
+                return (posterior, recurrent), (recurrent, posterior, post_ms, prior_ms)
+
+            keys_t = jax.random.split(k_wm, T)
+            init = (jnp.zeros((B, stochastic_size)), jnp.zeros((B, recurrent_size)))
+            _, (recurrents, posteriors, post_ms, prior_ms) = jax.lax.scan(
+                scan_body, init, (batch["actions"], embedded, keys_t)
+            )
+            latents = jnp.concatenate([posteriors, recurrents], axis=-1)
+            recon = world_model_def.apply(wm_params, latents, method="decode")
+            reward_mean = world_model_def.apply(wm_params, latents, method="reward_logits")
+            if use_continues:
+                qc = Bernoulli(
+                    world_model_def.apply(wm_params, latents, method="continue_logits"), event_dims=1
+                )
+                continues_targets = (1 - batch["terminated"]) * gamma
+            else:
+                qc = continues_targets = None
+            rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+                recon,
+                batch_obs,
+                reward_mean,
+                batch["rewards"],
+                post_ms,
+                prior_ms,
+                wm_cfg.kl_free_nats,
+                wm_cfg.kl_regularizer,
+                qc,
+                continues_targets,
+                wm_cfg.continue_scale_factor,
+            )
+            aux = {
+                "posteriors": posteriors,
+                "recurrents": recurrents,
+                "kl": kl,
+                "state_loss": state_loss,
+                "reward_loss": reward_loss,
+                "observation_loss": observation_loss,
+                "continue_loss": continue_loss,
+            }
+            return rec_loss, aux
+
+        (rec_loss, aux), wm_grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(params["world_model"])
+        updates, opt_states["world_model"] = optimizers["world_model"].update(
+            wm_grads, opt_states["world_model"], params["world_model"]
+        )
+        params["world_model"] = optax.apply_updates(params["world_model"], updates)
+
+        wm_params = params["world_model"]
+        posteriors = jax.lax.stop_gradient(aux["posteriors"]).reshape(T * B, stochastic_size)
+        recurrents = jax.lax.stop_gradient(aux["recurrents"]).reshape(T * B, recurrent_size)
+
+        def actor_loss_fn(actor_params):
+            latent0 = jnp.concatenate([posteriors, recurrents], axis=-1)
+
+            def img_body(carry, key_t):
+                prior, recurrent, latent = carry
+                k_act, k_dyn = jax.random.split(key_t)
+                actions = actor_def.apply(
+                    actor_params, jax.lax.stop_gradient(latent), k_act, False, method="act"
+                )
+                prior, recurrent = world_model_def.apply(
+                    wm_params, prior, recurrent, actions, k_dyn, method="imagination"
+                )
+                latent = jnp.concatenate([prior, recurrent], axis=-1)
+                return (prior, recurrent, latent), latent
+
+            keys_h = jax.random.split(k_img, horizon)
+            _, latents_h = jax.lax.scan(img_body, (posteriors, recurrents, latent0), keys_h)
+            imagined_trajectories = latents_h  # [H, TB, L] (reference keeps H states)
+
+            predicted_values = critic_def.apply(params["critic"], imagined_trajectories)
+            predicted_rewards = world_model_def.apply(wm_params, imagined_trajectories, method="reward_logits")
+            if use_continues:
+                predicted_continues = jax.nn.sigmoid(
+                    world_model_def.apply(wm_params, imagined_trajectories, method="continue_logits")
+                )
+            else:
+                predicted_continues = jnp.ones_like(jax.lax.stop_gradient(predicted_rewards)) * gamma
+
+            lambda_values = compute_lambda_values(
+                predicted_rewards,
+                predicted_values,
+                predicted_continues,
+                last_values=predicted_values[-1],
+                horizon=horizon,
+                lmbda=cfg.algo.lmbda,
+            )
+            discount = jnp.cumprod(
+                jnp.concatenate(
+                    [jnp.ones_like(predicted_continues[:1]), predicted_continues[:-2]], axis=0
+                ),
+                axis=0,
+            )
+            discount = jax.lax.stop_gradient(discount)
+            policy_loss = -jnp.mean(discount * lambda_values)
+            aux2 = {
+                "imagined_trajectories": jax.lax.stop_gradient(imagined_trajectories),
+                "lambda_values": jax.lax.stop_gradient(lambda_values),
+                "discount": discount,
+            }
+            return policy_loss, aux2
+
+        (policy_loss, aux2), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
+        updates, opt_states["actor"] = optimizers["actor"].update(
+            actor_grads, opt_states["actor"], params["actor"]
+        )
+        params["actor"] = optax.apply_updates(params["actor"], updates)
+
+        imagined_trajectories = aux2["imagined_trajectories"]
+        lambda_values = aux2["lambda_values"]
+        discount = aux2["discount"]
+
+        def critic_loss_fn(critic_params):
+            values = critic_def.apply(critic_params, imagined_trajectories)[:-1]
+            lp = normal_log_prob(values, lambda_values, 1)
+            return -jnp.mean(discount[..., 0] * lp)
+
+        value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+        updates, opt_states["critic"] = optimizers["critic"].update(
+            critic_grads, opt_states["critic"], params["critic"]
+        )
+        params["critic"] = optax.apply_updates(params["critic"], updates)
+
+        metrics = jnp.stack(
+            [
+                rec_loss,
+                aux["observation_loss"],
+                aux["reward_loss"],
+                aux["state_loss"],
+                aux["continue_loss"],
+                aux["kl"],
+                policy_loss,
+                value_loss,
+                optax.global_norm(wm_grads),
+                optax.global_norm(actor_grads),
+                optax.global_norm(critic_grads),
+            ]
+        )
+        return params, opt_states, metrics
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg):
+    world_size = runtime.world_size
+    num_envs = cfg.env.num_envs
+
+    state = runtime.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    cfg.env.frame_stack = 1
+
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+    if cfg.metric.log_level == 0:
+        aggregator.disabled = True
+    timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+
+    rng_key = runtime.seed_everything(cfg.seed)
+
+    envs = vectorized_env(
+        [
+            partial(RestartOnException, make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i))
+            for i in range(num_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+
+    world_model_def, actor_def, critic_def, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"] if state else None,
+        state["actor"] if state else None,
+        state["critic"] if state else None,
+    )
+    player = PlayerDV1(world_model_def, actor_def, actions_dim, num_envs)
+
+    optimizers = {
+        "world_model": optax.chain(
+            optax.clip_by_global_norm(cfg.algo.world_model.clip_gradients),
+            instantiate(cfg.algo.world_model.optimizer),
+        ),
+        "actor": optax.chain(
+            optax.clip_by_global_norm(cfg.algo.actor.clip_gradients),
+            instantiate(cfg.algo.actor.optimizer),
+        ),
+        "critic": optax.chain(
+            optax.clip_by_global_norm(cfg.algo.critic.clip_gradients),
+            instantiate(cfg.algo.critic.optimizer),
+        ),
+    }
+    opt_states = {
+        "world_model": optimizers["world_model"].init(params["world_model"]),
+        "actor": optimizers["actor"].init(params["actor"]),
+        "critic": optimizers["critic"].init(params["critic"]),
+    }
+    if state and "opt_states" in state:
+        opt_states = jax.tree_util.tree_map(
+            lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
+            opt_states,
+            state["opt_states"],
+        )
+
+    from sheeprl_tpu.parallel.mesh import replicated_sharding
+
+    if world_size > 1:
+        params = jax.device_put(params, replicated_sharding(runtime.mesh))
+        opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
+
+    train_step = make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg)
+
+    buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 4
+    rb = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=num_envs,
+        obs_keys=tuple(obs_keys),
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+        buffer_cls=SequentialReplayBuffer,
+    )
+    if state and cfg.buffer.checkpoint and "rb" in state and state["rb"] is not None:
+        rb.load_state_dict(state["rb"])
+
+    train_step_count = 0
+    last_train = 0
+    start_iter = (state["iter_num"] if state else 0) + 1
+    policy_step_count = state["iter_num"] * num_envs if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if cfg.checkpoint.resume_from:
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
+
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = np.asarray(obs[k])[np.newaxis]
+    step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    player.init_states(params["world_model"])
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step_count += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts and not cfg.checkpoint.resume_from:
+                real_actions = actions = np.asarray(envs.action_space.sample())
+                if not is_continuous:
+                    actions = np.concatenate(
+                        [
+                            np.eye(act_dim, dtype=np.float32)[act]
+                            for act, act_dim in zip(actions.reshape(len(actions_dim), -1), actions_dim)
+                        ],
+                        axis=-1,
+                    )
+            else:
+                rng_key, step_key = jax.random.split(rng_key)
+                torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+                actions_jnp = player.get_actions(params["world_model"], params["actor"], torch_obs, step_key)
+                actions = np.asarray(actions_jnp)
+                if is_continuous:
+                    real_actions = actions.reshape(num_envs, -1)
+                else:
+                    idxs = []
+                    start = 0
+                    for d in actions_dim:
+                        idxs.append(np.argmax(actions[..., start : start + d], axis=-1))
+                        start += d
+                    real_actions = np.stack(idxs, axis=-1)
+
+            step_data["actions"] = actions.reshape(1, num_envs, -1)
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+
+        step_data["is_first"] = np.zeros_like(step_data["terminated"])
+        if "final_info" in infos and "episode" in infos["final_info"]:
+            ep = infos["final_info"]["episode"]
+            mask = ep.get("_r", infos["final_info"].get("_episode"))
+            if mask is not None and np.any(mask):
+                for r, l in zip(ep["r"][mask], ep["l"][mask]):
+                    aggregator.update("Rewards/rew_avg", float(r))
+                    aggregator.update("Game/ep_len_avg", float(l))
+
+        real_next_obs = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+        if "final_obs" in infos:
+            for idx, final_obs in enumerate(infos["final_obs"]):
+                if final_obs is not None:
+                    for k in obs_keys:
+                        real_next_obs[k][idx] = np.asarray(final_obs[k])
+
+        for k in obs_keys:
+            step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        obs = next_obs
+
+        rewards = np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
+        step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
+        step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
+        step_data["rewards"] = np.tanh(rewards) if cfg.env.clip_rewards else rewards
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        if dones_idxes:
+            reset_data = {}
+            for k in obs_keys:
+                reset_data[k] = real_next_obs[k][dones_idxes][np.newaxis]
+            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
+            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+            reset_data["actions"] = np.zeros((1, len(dones_idxes), int(sum(actions_dim))), np.float32)
+            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
+            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            step_data["rewards"][:, dones_idxes] = 0
+            step_data["terminated"][:, dones_idxes] = 0
+            step_data["truncated"][:, dones_idxes] = 0
+            step_data["is_first"][:, dones_idxes] = 1
+            reset_mask = np.zeros((num_envs, 1), np.float32)
+            reset_mask[dones_idxes] = 1.0
+            player.init_states(params["world_model"], reset_mask)
+
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step_count - prefill_steps * policy_steps_per_iter)
+            if cfg.dry_run:
+                per_rank_gradient_steps = 1
+            if per_rank_gradient_steps > 0:
+                local_data = rb.sample(
+                    cfg.algo.per_rank_batch_size * world_size,
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                )
+                with timer("Time/train_time"):
+                    for i in range(per_rank_gradient_steps):
+                        batch = {}
+                        for k, v in local_data.items():
+                            arr = jnp.asarray(np.asarray(v[i]), jnp.float32)
+                            if k in cnn_keys:
+                                arr = arr / 255.0 - 0.5
+                            batch[k] = arr
+                        rng_key, train_key = jax.random.split(rng_key)
+                        params, opt_states, metrics = train_step(params, opt_states, batch, train_key)
+                    train_step_count += 1
+                metrics = np.asarray(metrics)
+                for name, value in zip(METRIC_ORDER, metrics):
+                    aggregator.update(name, float(value))
+
+        if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
+            metrics_dict = aggregator.compute()
+            timers = timer.compute()
+            if timers.get("Time/train_time", 0) > 0:
+                metrics_dict["Time/sps_train"] = (train_step_count - last_train) / timers["Time/train_time"]
+            if timers.get("Time/env_interaction_time", 0) > 0:
+                metrics_dict["Time/sps_env_interaction"] = (
+                    (policy_step_count - last_log) * cfg.env.action_repeat
+                ) / timers["Time/env_interaction_time"]
+            if runtime.is_global_zero:
+                logger.log_metrics(metrics_dict, policy_step_count)
+            aggregator.reset()
+            timer.reset()
+            last_log = policy_step_count
+            last_train = train_step_count
+
+        if (
+            (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step_count
+            ckpt_state = {
+                "world_model": jax.tree_util.tree_map(np.asarray, params["world_model"]),
+                "actor": jax.tree_util.tree_map(np.asarray, params["actor"]),
+                "critic": jax.tree_util.tree_map(np.asarray, params["critic"]),
+                "opt_states": jax.tree_util.tree_map(np.asarray, opt_states),
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
+            runtime.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        cumulative_rew = test(player, params["world_model"], params["actor"], runtime, cfg, log_dir, greedy=False)
+        logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, policy_step_count)
+    logger.finalize()

@@ -1,0 +1,48 @@
+"""DreamerV1 losses (reference /root/reference/sheeprl/algos/dreamer_v1/loss.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v2.loss import normal_log_prob
+from sheeprl_tpu.ops.distributions import Bernoulli
+
+
+def kl_normal(p_mean, p_std, q_mean, q_std, event_dims: int = 1) -> jax.Array:
+    """KL(N(p) || N(q)) summed over the stochastic axis."""
+    var_ratio = (p_std / q_std) ** 2
+    t1 = ((p_mean - q_mean) / q_std) ** 2
+    kl = 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return jnp.sum(kl, axis=tuple(range(-event_dims, 0)))
+
+
+def reconstruction_loss(
+    recon: Dict[str, jax.Array],
+    observations: Dict[str, jax.Array],
+    reward_mean: jax.Array,
+    rewards: jax.Array,
+    posterior_mean_std: Tuple[jax.Array, jax.Array],
+    prior_mean_std: Tuple[jax.Array, jax.Array],
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    qc: Optional[Bernoulli] = None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[jax.Array, ...]:
+    """Reference loss.py:40-100: Normal recon/reward, Gaussian KL with free
+    nats applied to the mean."""
+    observation_loss = -sum(
+        jnp.mean(normal_log_prob(recon[k], observations[k], len(recon[k].shape[2:]))) for k in recon
+    )
+    reward_loss = -jnp.mean(normal_log_prob(reward_mean, rewards, 1))
+    kl = jnp.mean(kl_normal(posterior_mean_std[0], posterior_mean_std[1], prior_mean_std[0], prior_mean_std[1]))
+    state_loss = jnp.maximum(kl, kl_free_nats)
+    if qc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -jnp.mean(qc.log_prob(continue_targets))
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    return rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss

@@ -1,0 +1,55 @@
+"""DreamerV1 helpers (reference /root/reference/sheeprl/algos/dreamer_v1/utils.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    last_values: jax.Array,
+    horizon: int = 15,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """DV1 lambda targets over ``horizon-1`` steps (reference utils.py:42-77):
+    the last step bootstraps the full ``last_values`` (no ``1-lambda``)."""
+    next_vals = values[1 : horizon - 1] * (1 - lmbda)
+    next_vals = jnp.concatenate([next_vals, last_values[None]], axis=0)  # [H-1]
+
+    def body(agg, inp):
+        r_t, nv_t, c_t = inp
+        delta = r_t + nv_t * c_t
+        agg = delta + lmbda * c_t * agg
+        return agg, agg
+
+    _, lv = jax.lax.scan(
+        body,
+        jnp.zeros_like(last_values),
+        (rewards[: horizon - 1], next_vals, continues[: horizon - 1]),
+        reverse=True,
+    )
+    return lv

@@ -253,6 +253,36 @@ def test_dreamer_v2_use_continues(devices):
     )
 
 
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_dreamer_v1(devices, env_id):
+    _run_cli(
+        "exp=dreamer_v1",
+        *COMMON,
+        "algo.per_rank_batch_size=1",
+        "algo.per_rank_sequence_length=1",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.horizon=8",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.cnn_keys.decoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.mlp_keys.decoder=[state]",
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "env=dummy",
+        f"env.id={env_id}",
+        "buffer.size=8",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
 def test_droq(devices):
     _run_cli(
         "exp=droq",
