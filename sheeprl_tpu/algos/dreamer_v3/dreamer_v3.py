@@ -300,6 +300,12 @@ METRIC_ORDER = [
 
 @register_algorithm()
 def main(runtime, cfg):
+    return _dreamer_main(runtime, cfg, build_agent, make_train_step)
+
+
+def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_setup=None):
+    """Shared DV3-family loop; the JEPA variant swaps in its own agent
+    builder and train step (algos/dreamer_v3_jepa)."""
     world_size = runtime.world_size
     num_envs = cfg.env.num_envs
 
@@ -341,14 +347,15 @@ def main(runtime, cfg):
     )
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
-    if (
+    has_decoders = len(cfg.algo.cnn_keys.decoder) + len(cfg.algo.mlp_keys.decoder) > 0
+    if has_decoders and (
         len(set(cfg.algo.cnn_keys.encoder).intersection(set(cfg.algo.cnn_keys.decoder))) == 0
         and len(set(cfg.algo.mlp_keys.encoder).intersection(set(cfg.algo.mlp_keys.decoder))) == 0
     ):
         raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
     obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
 
-    world_model_def, actor_def, critic_def, params = build_agent(
+    world_model_def, actor_def, critic_def, params = build_agent_fn(
         runtime,
         actions_dim,
         is_continuous,
@@ -380,6 +387,8 @@ def main(runtime, cfg):
         "actor": optimizers["actor"].init(params["actor"]),
         "critic": optimizers["critic"].init(params["critic"]),
     }
+    if extra_opt_setup is not None:
+        opt_states = extra_opt_setup(optimizers, opt_states, params)
     if state and "opt_states" in state:
         opt_states = jax.tree_util.tree_map(
             lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
@@ -397,7 +406,7 @@ def main(runtime, cfg):
         opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
         moments_state = jax.device_put(moments_state, replicated_sharding(runtime.mesh))
 
-    train_step = make_train_step(
+    train_step = make_train_step_fn(
         world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, is_continuous
     )
 
@@ -606,6 +615,11 @@ def main(runtime, cfg):
                 "actor": jax.tree_util.tree_map(np.asarray, params["actor"]),
                 "critic": jax.tree_util.tree_map(np.asarray, params["critic"]),
                 "target_critic": jax.tree_util.tree_map(np.asarray, params["target_critic"]),
+                **{
+                    k: jax.tree_util.tree_map(np.asarray, v)
+                    for k, v in params.items()
+                    if k not in ("world_model", "actor", "critic", "target_critic")
+                },
                 "opt_states": jax.tree_util.tree_map(np.asarray, opt_states),
                 "moments": jax.tree_util.tree_map(np.asarray, moments_state),
                 "ratio": ratio.state_dict(),

@@ -1,0 +1,77 @@
+"""DreamerV3-JEPA agent (fork feature, reference
+/root/reference/sheeprl/algos/dreamer_v3_jepa/agent.py): DV3 with optional
+decoder-free world model plus a JEPA head over the encoder.
+
+Params layout extends DV3's with ``params["jepa"] = {projector, predictor,
+target_encoder, target_projector}`` where the target branches are EMA copies
+of the online encoder/projector params (reference JEPAHead deep-copy,
+models/jepa.py:74-124).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3, build_agent as _dv3_build_agent
+from sheeprl_tpu.models.jepa import JEPAPredictor, JEPAProjector
+
+PlayerDV3JEPA = PlayerDV3
+
+
+def encoder_subtree(wm_params: Dict[str, Any]) -> Dict[str, Any]:
+    """Extract the encoder submodule params (enough for apply(method='encode'))."""
+    inner = wm_params["params"]
+    sub = {k: v for k, v in inner.items() if k in ("cnn_encoder", "mlp_encoder")}
+    return {"params": sub}
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+    target_critic_state: Optional[Dict[str, Any]] = None,
+):
+    world_model_def, actor_def, critic_def, params = _dv3_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_state,
+        critic_state,
+        target_critic_state,
+    )
+    projector_def = JEPAProjector(proj_dim=cfg.algo.jepa_proj_dim, hidden=cfg.algo.jepa_hidden)
+    predictor_def = JEPAPredictor(proj_dim=cfg.algo.jepa_proj_dim, hidden=cfg.algo.jepa_hidden)
+
+    # probe the encoder output dim with a dummy forward
+    from math import prod
+
+    sample_obs: Dict[str, jax.Array] = {}
+    for k in cfg.algo.cnn_keys.encoder:
+        sample_obs[k] = jnp.zeros((1, 1) + tuple(obs_space[k].shape), jnp.float32)
+    for k in cfg.algo.mlp_keys.encoder:
+        sample_obs[k] = jnp.zeros((1, 1, int(prod(obs_space[k].shape))), jnp.float32)
+    embedded = world_model_def.apply(params["world_model"], sample_obs, method="encode")
+    k1, k2 = jax.random.split(jax.random.PRNGKey((cfg.seed or 0) + 1))
+    projector_params = projector_def.init(k1, embedded)
+    predictor_params = predictor_def.init(k2, jnp.zeros((1, cfg.algo.jepa_proj_dim), jnp.float32))
+    if "jepa" not in params:
+        params["jepa"] = {
+            "projector": projector_params,
+            "predictor": predictor_params,
+            "target_encoder": jax.tree_util.tree_map(jnp.copy, encoder_subtree(params["world_model"])),
+            "target_projector": jax.tree_util.tree_map(jnp.copy, projector_params),
+        }
+    if world_model_state is not None and isinstance(world_model_state, dict) and "jepa" in world_model_state:
+        params["jepa"] = jax.tree_util.tree_map(jnp.asarray, world_model_state["jepa"])
+    return world_model_def, actor_def, critic_def, (projector_def, predictor_def), params

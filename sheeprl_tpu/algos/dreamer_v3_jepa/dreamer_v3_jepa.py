@@ -1,0 +1,307 @@
+"""DreamerV3-JEPA training loop (fork feature, reference
+/root/reference/sheeprl/algos/dreamer_v3_jepa/dreamer_v3_jepa.py:100-909).
+
+DV3 with a decoder-optional world model and a JEPA auxiliary loss on the
+encoder: two masked views of the batch are encoded (online vs EMA-target
+branch) and a cosine prediction loss (weight ``jepa_coef``) is added to the
+world-model objective; the target encoder/projector track the online ones
+with momentum ``jepa_ema`` (reference :230-246).  The JEPA projector and
+predictor train under the world-model optimizer, exactly like the reference
+attaches the head to the WorldModel module (agent.py:96).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _dreamer_main
+from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v3.utils import update_moments
+from sheeprl_tpu.algos.dreamer_v3_jepa.agent import build_agent as _build_agent_full, encoder_subtree
+from sheeprl_tpu.algos.dreamer_v3_jepa.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER  # noqa: F401
+from sheeprl_tpu.models.jepa import jepa_loss, make_two_views
+from sheeprl_tpu.ops.distributions import (
+    Bernoulli,
+    MSEDistribution,
+    SymlogDistribution,
+    TwoHotEncodingDistribution,
+)
+from sheeprl_tpu.ops.numerics import compute_lambda_values
+from sheeprl_tpu.utils.registry import register_algorithm
+
+_HEADS = {}  # filled by the wrapped build_agent; keyed per-process (single controller)
+
+
+def _build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, *states):
+    world_model_def, actor_def, critic_def, head_defs, params = _build_agent_full(
+        runtime, actions_dim, is_continuous, cfg, obs_space, *states
+    )
+    _HEADS["projector_def"], _HEADS["predictor_def"] = head_defs
+    return world_model_def, actor_def, critic_def, params
+
+
+def make_train_step(
+    world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim: Sequence[int], is_continuous: bool
+):
+    wm_cfg = cfg.algo.world_model
+    stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
+    recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
+    horizon = cfg.algo.horizon
+    gamma = cfg.algo.gamma
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
+    jepa_coef = cfg.algo.jepa_coef
+    ema_m = cfg.algo.jepa_ema
+    projector_def = _HEADS["projector_def"]
+    predictor_def = _HEADS["predictor_def"]
+
+    def train_step(params, opt_states, moments_state, batch, key, tau):
+        T, B = batch["actions"].shape[:2]
+        k_wm, k_img, k_img_actions, k_views = jax.random.split(key, 4)
+
+        params["target_critic"] = jax.tree_util.tree_map(
+            lambda c, t: tau * c + (1 - tau) * t, params["critic"], params["target_critic"]
+        )
+
+        batch_obs = {k: batch[k] for k in set(cnn_keys + mlp_keys)}
+        # JEPA views need (T,B,C,H,W) pixels / (T,B,D) vectors
+        view_obs = {k: batch_obs[k] for k in batch_obs}
+        obs_q, obs_k = make_two_views(
+            view_obs, k_views, cfg.algo.jepa_mask.erase_frac, cfg.algo.jepa_mask.vec_dropout
+        )
+        batch_actions = jnp.concatenate(
+            [jnp.zeros_like(batch["actions"][:1]), batch["actions"][:-1]], axis=0
+        )
+        is_first = batch["is_first"].at[0].set(1.0)
+
+        def wm_loss_fn(combined):
+            wm_params, jepa_online = combined
+            embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
+
+            def scan_body(carry, x):
+                posterior, recurrent = carry
+                action_t, embed_t, is_first_t, key_t = x
+                recurrent, posterior, _, post_logits, prior_logits = world_model_def.apply(
+                    wm_params, posterior, recurrent, action_t, embed_t, is_first_t, key_t, method="dynamic"
+                )
+                return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
+
+            keys_t = jax.random.split(k_wm, T)
+            init = (jnp.zeros((B, stoch_flat)), jnp.zeros((B, recurrent_size)))
+            _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
+                scan_body, init, (batch_actions, embedded, is_first, keys_t)
+            )
+            latents = jnp.concatenate([posteriors, recurrents], axis=-1)
+            recon = world_model_def.apply(wm_params, latents, method="decode")
+            po = {k: MSEDistribution(recon[k], dims=len(recon[k].shape[2:])) for k in cnn_dec_keys}
+            po.update({k: SymlogDistribution(recon[k], dims=len(recon[k].shape[2:])) for k in mlp_dec_keys})
+            pr = TwoHotEncodingDistribution(
+                world_model_def.apply(wm_params, latents, method="reward_logits"), dims=1
+            )
+            pc = Bernoulli(
+                world_model_def.apply(wm_params, latents, method="continue_logits"), event_dims=1
+            )
+            continues_targets = 1 - batch["terminated"]
+            pl = prior_logits.reshape(T, B, wm_cfg.stochastic_size, wm_cfg.discrete_size)
+            ql = post_logits.reshape(T, B, wm_cfg.stochastic_size, wm_cfg.discrete_size)
+            rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+                po,
+                {k: batch_obs[k] for k in set(cnn_dec_keys + mlp_dec_keys)},
+                pr,
+                batch["rewards"],
+                pl,
+                ql,
+                wm_cfg.kl_dynamic,
+                wm_cfg.kl_representation,
+                wm_cfg.kl_free_nats,
+                wm_cfg.kl_regularizer,
+                pc,
+                continues_targets,
+                wm_cfg.continue_scale_factor,
+            )
+            # --- JEPA auxiliary objective (reference :230-231) ------------
+            jl = jepa_loss(
+                lambda o: world_model_def.apply(wm_params, o, method="encode"),
+                lambda o: world_model_def.apply(params["jepa"]["target_encoder"], o, method="encode"),
+                projector_def,
+                predictor_def,
+                jepa_online["projector"],
+                jepa_online["predictor"],
+                params["jepa"]["target_projector"],
+                obs_q,
+                obs_k,
+            )
+            total = rec_loss + jepa_coef * jl
+            aux = {
+                "posteriors": posteriors,
+                "recurrents": recurrents,
+                "kl": kl,
+                "state_loss": state_loss,
+                "reward_loss": reward_loss,
+                "observation_loss": observation_loss,
+                "continue_loss": continue_loss,
+                "jepa_loss": jl,
+                "rec_loss": rec_loss,
+            }
+            return total, aux
+
+        jepa_online = {"projector": params["jepa"]["projector"], "predictor": params["jepa"]["predictor"]}
+        (total_loss, aux), grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(
+            (params["world_model"], jepa_online)
+        )
+        updates, opt_states["world_model"] = optimizers["world_model"].update(
+            grads, opt_states["world_model"], (params["world_model"], jepa_online)
+        )
+        (params["world_model"], jepa_online) = optax.apply_updates(
+            (params["world_model"], jepa_online), updates
+        )
+        params["jepa"]["projector"] = jepa_online["projector"]
+        params["jepa"]["predictor"] = jepa_online["predictor"]
+
+        # --- JEPA momentum update (reference :245-246) ---------------------
+        params["jepa"]["target_encoder"] = optax.incremental_update(
+            encoder_subtree(params["world_model"]), params["jepa"]["target_encoder"], 1 - ema_m
+        )
+        params["jepa"]["target_projector"] = optax.incremental_update(
+            params["jepa"]["projector"], params["jepa"]["target_projector"], 1 - ema_m
+        )
+
+        # ---------------- BEHAVIOUR LEARNING (same as DV3) -----------------
+        wm_params = params["world_model"]
+        posteriors = jax.lax.stop_gradient(aux["posteriors"]).reshape(T * B, stoch_flat)
+        recurrents = jax.lax.stop_gradient(aux["recurrents"]).reshape(T * B, recurrent_size)
+        true_continue = (1 - batch["terminated"]).reshape(T * B, 1)
+
+        def actor_loss_fn(actor_params, moments_state):
+            latent0 = jnp.concatenate([posteriors, recurrents], axis=-1)
+            a0 = actor_def.apply(actor_params, jax.lax.stop_gradient(latent0), k_img_actions, False, method="act")
+
+            def img_body(carry, key_t):
+                prior, recurrent, actions = carry
+                k_dyn, k_act = jax.random.split(key_t)
+                prior, recurrent = world_model_def.apply(
+                    wm_params, prior, recurrent, actions, k_dyn, method="imagination"
+                )
+                latent = jnp.concatenate([prior, recurrent], axis=-1)
+                actions = actor_def.apply(
+                    actor_params, jax.lax.stop_gradient(latent), k_act, False, method="act"
+                )
+                return (prior, recurrent, actions), (latent, actions)
+
+            keys_h = jax.random.split(k_img, horizon)
+            _, (latents_h, actions_h) = jax.lax.scan(img_body, (posteriors, recurrents, a0), keys_h)
+            imagined_trajectories = jnp.concatenate([latent0[None], latents_h], axis=0)
+            imagined_actions = jnp.concatenate([a0[None], actions_h], axis=0)
+
+            predicted_values = TwoHotEncodingDistribution(
+                critic_def.apply(params["critic"], imagined_trajectories), dims=1
+            ).mean
+            predicted_rewards = TwoHotEncodingDistribution(
+                world_model_def.apply(wm_params, imagined_trajectories, method="reward_logits"), dims=1
+            ).mean
+            continues = Bernoulli(
+                world_model_def.apply(wm_params, imagined_trajectories, method="continue_logits"),
+                event_dims=1,
+            ).mode
+            continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
+
+            lambda_values = compute_lambda_values(
+                predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda=cfg.algo.lmbda
+            )
+            discount = jnp.cumprod(continues * gamma, axis=0) / gamma
+            discount = jax.lax.stop_gradient(discount)
+            baseline = predicted_values[:-1]
+            offset, invscale, new_moments = update_moments(
+                moments_state,
+                lambda_values,
+                cfg.algo.actor.moments.decay,
+                cfg.algo.actor.moments.max,
+                cfg.algo.actor.moments.percentile.low,
+                cfg.algo.actor.moments.percentile.high,
+            )
+            advantage = (lambda_values - offset) / invscale - (baseline - offset) / invscale
+            log_probs, entropies = actor_def.apply(
+                actor_params,
+                jax.lax.stop_gradient(imagined_trajectories),
+                jax.lax.stop_gradient(imagined_actions),
+                method="log_prob_entropy",
+            )
+            if is_continuous:
+                objective = advantage
+            else:
+                objective = log_probs[:-1] * jax.lax.stop_gradient(advantage)
+            entropy = cfg.algo.actor.ent_coef * entropies
+            policy_loss = -jnp.mean(discount[:-1] * (objective + entropy[:-1]))
+            aux2 = {
+                "imagined_trajectories": jax.lax.stop_gradient(imagined_trajectories),
+                "lambda_values": jax.lax.stop_gradient(lambda_values),
+                "discount": discount,
+                "moments": new_moments,
+            }
+            return policy_loss, aux2
+
+        (policy_loss, aux2), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+            params["actor"], moments_state
+        )
+        updates, opt_states["actor"] = optimizers["actor"].update(
+            actor_grads, opt_states["actor"], params["actor"]
+        )
+        params["actor"] = optax.apply_updates(params["actor"], updates)
+        moments_state = aux2["moments"]
+
+        imagined_trajectories = aux2["imagined_trajectories"]
+        lambda_values = aux2["lambda_values"]
+        discount = aux2["discount"]
+
+        def critic_loss_fn(critic_params):
+            qv = TwoHotEncodingDistribution(critic_def.apply(critic_params, imagined_trajectories[:-1]), dims=1)
+            predicted_target_values = TwoHotEncodingDistribution(
+                critic_def.apply(params["target_critic"], imagined_trajectories[:-1]), dims=1
+            ).mean
+            value_loss = -qv.log_prob(lambda_values)
+            value_loss = value_loss - qv.log_prob(jax.lax.stop_gradient(predicted_target_values))
+            return jnp.mean(value_loss * discount[:-1, ..., 0])
+
+        value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+        updates, opt_states["critic"] = optimizers["critic"].update(
+            critic_grads, opt_states["critic"], params["critic"]
+        )
+        params["critic"] = optax.apply_updates(params["critic"], updates)
+
+        metrics = jnp.stack(
+            [
+                aux["rec_loss"] + jepa_coef * aux["jepa_loss"],
+                aux["observation_loss"],
+                aux["reward_loss"],
+                aux["state_loss"],
+                aux["continue_loss"],
+                aux["kl"],
+                policy_loss,
+                value_loss,
+                optax.global_norm(grads[0]),
+                optax.global_norm(actor_grads),
+                optax.global_norm(critic_grads),
+            ]
+        )
+        return params, opt_states, moments_state, metrics
+
+    return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+
+def _extra_opt_setup(optimizers, opt_states, params):
+    """The world optimizer also trains the JEPA projector/predictor
+    (reference: jepa head is attached to the WorldModel module)."""
+    jepa_online = {"projector": params["jepa"]["projector"], "predictor": params["jepa"]["predictor"]}
+    opt_states["world_model"] = optimizers["world_model"].init((params["world_model"], jepa_online))
+    return opt_states
+
+
+@register_algorithm()
+def main(runtime, cfg):
+    return _dreamer_main(runtime, cfg, _build_agent, make_train_step, extra_opt_setup=_extra_opt_setup)

@@ -1,0 +1,31 @@
+"""DreamerV3-JEPA helper surface
+(reference /root/reference/sheeprl/algos/dreamer_v3_jepa/utils.py)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401
+    init_moments_state,
+    prepare_obs,
+    test,
+    update_moments,
+)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/jepa_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}

@@ -1,0 +1,105 @@
+"""JEPA self-supervised blocks (fork feature, reference
+/root/reference/sheeprl/models/jepa.py:10-124).
+
+Functional re-design: the reference's `JEPAHead` holds a deep-copied frozen
+EMA target branch as module state; here the online projector/predictor and
+the target encoder/projector are separate params subtrees, the EMA update is
+an `optax.incremental_update` with rate ``1 - ema_m``, and the masking
+augmentations are pure keyed functions.  The projector uses LayerNorm in
+place of the reference's BatchNorm1d (no mutable batch statistics inside the
+jitted step; BYOL-style heads are robust to this substitution).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _erase_rectangles(x: jax.Array, erase_frac: float) -> jax.Array:
+    """Center-crop mask: keep a centered (1-erase_frac) rectangle
+    (reference jepa.py:10-22).  ``x`` is (T, B, C, H, W)."""
+    T, B, C, H, W = x.shape
+    h = max(1, min(H, int(H * (1 - erase_frac))))
+    w = max(1, min(W, int(W * (1 - erase_frac))))
+    top = (H - h) // 2
+    left = (W - w) // 2
+    mask = jnp.zeros((1, 1, 1, H, W), dtype=x.dtype)
+    mask = mask.at[..., top : top + h, left : left + w].set(1.0)
+    return x * mask
+
+
+def make_two_views(
+    obs: Dict[str, jax.Array], key: jax.Array, erase_frac: float = 0.6, vec_dropout: float = 0.2
+) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """Two stochastic views (reference jepa.py:26-41)."""
+    obs_q: Dict[str, jax.Array] = {}
+    obs_k: Dict[str, jax.Array] = {}
+    for i, (k, v) in enumerate(sorted(obs.items())):
+        k1, k2 = jax.random.split(jax.random.fold_in(key, i))
+        if v.ndim == 5:  # (T, B, C, H, W)
+            obs_q[k] = _erase_rectangles(v, erase_frac)
+            obs_k[k] = _erase_rectangles(v, erase_frac)
+        else:
+            obs_q[k] = v + jax.random.normal(k1, v.shape, v.dtype) * vec_dropout
+            obs_k[k] = v + jax.random.normal(k2, v.shape, v.dtype) * vec_dropout
+    return obs_q, obs_k
+
+
+class JEPAProjector(nn.Module):
+    """Dense → LayerNorm → ReLU → Dense, mean-pooled over time
+    (reference jepa.py:44-60)."""
+
+    proj_dim: int = 1024
+    hidden: int = 1024
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        if z.ndim == 3:
+            z = jnp.mean(z, axis=0)
+        z = nn.Dense(self.hidden)(z)
+        z = nn.LayerNorm()(z)
+        z = jax.nn.relu(z)
+        return nn.Dense(self.proj_dim)(z)
+
+
+class JEPAPredictor(nn.Module):
+    """Dense → ReLU → Dense (reference jepa.py:63-73)."""
+
+    proj_dim: int = 1024
+    hidden: int = 1024
+
+    @nn.compact
+    def __call__(self, p: jax.Array) -> jax.Array:
+        p = nn.Dense(self.hidden)(p)
+        p = jax.nn.relu(p)
+        return nn.Dense(self.proj_dim)(p)
+
+
+def l2_normalize(x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    return x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + eps)
+
+
+def jepa_loss(
+    encode_q,  # callable(obs) -> embeddings using ONLINE encoder params (differentiable)
+    encode_k,  # callable(obs) -> embeddings using TARGET encoder params
+    projector_def: JEPAProjector,
+    predictor_def: JEPAPredictor,
+    projector_params,
+    predictor_params,
+    target_projector_params,
+    obs_q: Dict[str, jax.Array],
+    obs_k: Dict[str, jax.Array],
+) -> jax.Array:
+    """Cosine (BYOL-style) loss 2 - 2 <pq, zk> (reference JEPAHead.forward,
+    jepa.py:104-117)."""
+    zq = encode_q(obs_q)
+    zk = jax.lax.stop_gradient(encode_k(obs_k))
+    pq = predictor_def.apply(predictor_params, projector_def.apply(projector_params, zq))
+    zk = jax.lax.stop_gradient(projector_def.apply(target_projector_params, zk))
+    pq = l2_normalize(pq)
+    zk = l2_normalize(zk)
+    return 2.0 - 2.0 * jnp.mean(jnp.sum(pq * zk, axis=-1))

@@ -283,6 +283,24 @@ def test_dreamer_v1(devices, env_id):
     assert _checkpoint_paths(), "no checkpoint written"
 
 
+def test_dreamer_v3_jepa(devices):
+    _run_cli(
+        "exp=dreamer_v3_jepa",
+        *COMMON,
+        *DV3_TINY,
+        "algo.cnn_keys.decoder=[]",
+        "algo.mlp_keys.decoder=[]",
+        "algo.jepa_proj_dim=8",
+        "algo.jepa_hidden=8",
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "buffer.size=8",
+    )
+    assert _checkpoint_paths(), "no checkpoint written"
+
+
 def test_droq(devices):
     _run_cli(
         "exp=droq",
