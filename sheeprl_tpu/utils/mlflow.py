@@ -1,0 +1,134 @@
+"""MLflow model-registry integration
+(reference /root/reference/sheeprl/utils/mlflow.py:75-427).
+
+JAX params pytrees are logged as pickled artifacts via ``mlflow.pyfunc`` with
+a thin loader wrapper.  Everything is gated on mlflow availability — the API
+surface exists (and raises a clear error) even when the package is absent,
+like the reference's ``_IS_MLFLOW_AVAILABLE`` import gates
+(utils/imports.py:1-17).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import warnings
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+from sheeprl_tpu.utils.utils import npify
+
+
+class AbstractModelManager:
+    def __init__(self, runtime):
+        self.runtime = runtime
+
+    def register_model(self, model_location: str, model_name: str, description=None, tags=None):
+        raise NotImplementedError
+
+    def download_model(self, model_name: str, version: int, output_path: str):
+        raise NotImplementedError
+
+    def transition_model(self, model_name: str, version: int, stage: str, description=None):
+        raise NotImplementedError
+
+    def delete_model(self, model_name: str, version: int, description=None):
+        raise NotImplementedError
+
+    def get_latest_version(self, model_name: str):
+        raise NotImplementedError
+
+
+class MlflowModelManager(AbstractModelManager):
+    """Register / transition / download / delete model versions in the MLflow
+    registry (reference mlflow.py:75-427)."""
+
+    def __init__(self, runtime, tracking_uri: Optional[str] = None):
+        if not _IS_MLFLOW_AVAILABLE:
+            raise ModuleNotFoundError(
+                "mlflow is not installed; install it to use the model registry "
+                "(the training loops run without it)"
+            )
+        super().__init__(runtime)
+        import mlflow
+        from mlflow.tracking import MlflowClient
+
+        self.tracking_uri = tracking_uri or os.environ.get("MLFLOW_TRACKING_URI")
+        mlflow.set_tracking_uri(self.tracking_uri)
+        self.client = MlflowClient()
+
+    def register_model(self, model_location: str, model_name: str, description=None, tags=None):
+        import mlflow
+
+        model_version = mlflow.register_model(model_uri=model_location, name=model_name, tags=tags)
+        if description:
+            self.client.update_model_version(model_name, model_version.version, description=description)
+        return model_version
+
+    def get_latest_version(self, model_name: str):
+        versions = self.client.search_model_versions(f"name = '{model_name}'")
+        return max(versions, key=lambda v: int(v.version)) if versions else None
+
+    def transition_model(self, model_name: str, version: int, stage: str, description=None):
+        return self.client.transition_model_version_stage(model_name, str(version), stage)
+
+    def download_model(self, model_name: str, version: int, output_path: str):
+        import mlflow
+
+        os.makedirs(output_path, exist_ok=True)
+        return mlflow.artifacts.download_artifacts(
+            artifact_uri=f"models:/{model_name}/{version}", dst_path=output_path
+        )
+
+    def delete_model(self, model_name: str, version: int, description=None):
+        self.client.delete_model_version(model_name, str(version))
+
+
+def log_models(
+    cfg,
+    models: Dict[str, Any],
+    log_dir: str,
+    run_id: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Log params pytrees as MLflow artifacts and register them per
+    ``cfg.model_manager.models`` (reference mlflow.py + per-algo
+    ``log_models``, e.g. algos/dreamer_v1/utils.py:110-160)."""
+    if not _IS_MLFLOW_AVAILABLE:
+        warnings.warn("mlflow is not installed: skipping model registration")
+        return {}
+    import mlflow
+
+    infos = {}
+    with mlflow.start_run(run_id=run_id, nested=True) as run:
+        for name, params in models.items():
+            if name not in cfg.model_manager.models:
+                continue
+            meta = cfg.model_manager.models[name]
+            with tempfile.TemporaryDirectory() as tmp:
+                path = os.path.join(tmp, f"{name}.pkl")
+                with open(path, "wb") as fp:
+                    pickle.dump(npify(params), fp)
+                mlflow.log_artifact(path, artifact_path=name)
+            model_uri = f"runs:/{run.info.run_id}/{name}"
+            version = mlflow.register_model(model_uri, meta["model_name"], tags=meta.get("tags"))
+            infos[name] = version
+    return infos
+
+
+def register_model_from_checkpoint(cfg) -> None:
+    """``sheeprl-registration`` entrypoint body (reference cli.py:408-450 +
+    mlflow.register_model_from_checkpoint)."""
+    if not _IS_MLFLOW_AVAILABLE:
+        raise ModuleNotFoundError("mlflow is not installed; cannot register models")
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    state = load_state(cfg.checkpoint_path)
+    models = {
+        k: state[k]
+        for k in cfg.model_manager.models.keys()
+        if k in state
+    }
+    log_models(cfg, models, log_dir=os.path.dirname(cfg.checkpoint_path))
