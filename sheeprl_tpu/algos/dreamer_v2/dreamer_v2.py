@@ -35,6 +35,7 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, 
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import Bernoulli
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -56,7 +57,8 @@ METRIC_ORDER = [
 ]
 
 
-def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, is_continuous):
+def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, is_continuous, mesh=None):
+    axis = dp_axis(mesh)
     wm_cfg = cfg.algo.world_model
     stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
     recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
@@ -69,6 +71,7 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
 
     def train_step(params, opt_states, batch, key, tau):
         T, B = batch["actions"].shape[:2]
+        key = fold_key(key, axis)
         k_wm, k_img = jax.random.split(key)
 
         # hard target-critic update every N gradient steps (reference :369-374)
@@ -134,6 +137,7 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
             return rec_loss, aux
 
         (rec_loss, aux), wm_grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(params["world_model"])
+        wm_grads = pmean_tree(wm_grads, axis)
         updates, opt_states["world_model"] = optimizers["world_model"].update(
             wm_grads, opt_states["world_model"], params["world_model"]
         )
@@ -208,6 +212,7 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
             return policy_loss, aux2
 
         (policy_loss, aux2), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
+        actor_grads = pmean_tree(actor_grads, axis)
         updates, opt_states["actor"] = optimizers["actor"].update(
             actor_grads, opt_states["actor"], params["actor"]
         )
@@ -223,6 +228,7 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
             return -jnp.mean(discount[:-1, ..., 0] * lp)
 
         value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+        critic_grads = pmean_tree(critic_grads, axis)
         updates, opt_states["critic"] = optimizers["critic"].update(
             critic_grads, opt_states["critic"], params["critic"]
         )
@@ -243,9 +249,16 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
                 optax.global_norm(critic_grads),
             ]
         )
+        metrics = pmean_tree(metrics, axis)
         return params, opt_states, metrics
 
-    return jax.jit(train_step, donate_argnums=(0, 1))
+    return dp_jit(
+        train_step,
+        mesh,
+        in_specs=(P(), P(), batch_spec(batch_axis=1), P(), P()),
+        out_specs=(P(), P(), P()),
+        donate_argnums=(0, 1),
+    )
 
 
 @register_algorithm()
@@ -337,7 +350,14 @@ def main(runtime, cfg):
         opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
 
     train_step = make_train_step(
-        world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, is_continuous
+        world_model_def,
+        actor_def,
+        critic_def,
+        optimizers,
+        cfg,
+        actions_dim,
+        is_continuous,
+        mesh=runtime.mesh if world_size > 1 else None,
     )
 
     # ---- buffer: sequential or episode (reference dreamer_v2.py:496-517) --
@@ -502,9 +522,15 @@ def main(runtime, cfg):
                             tau = 1.0
                         else:
                             tau = 0.0
+                        # stage [T, B_total, ...] with B sharded over the mesh
+                        staged = stage(
+                            {k: np.asarray(v[i]) for k, v in local_data.items()},
+                            runtime.mesh if world_size > 1 else None,
+                            batch_axis=1,
+                        )
                         batch = {}
-                        for k, v in local_data.items():
-                            arr = jnp.asarray(np.asarray(v[i]), jnp.float32)
+                        for k, arr in staged.items():
+                            arr = arr.astype(jnp.float32)
                             if k in cnn_keys:
                                 arr = arr / 255.0 - 0.5
                             batch[k] = arr

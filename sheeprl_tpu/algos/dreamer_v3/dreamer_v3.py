@@ -11,9 +11,15 @@ H=15, :235-241).  Here each gradient step is ONE jitted XLA graph:
   reference's autograd tape does;
 - the three optimizer updates (world/actor/critic), the Moments percentile
   EMA and the target-critic Polyak update all live in the same graph;
-- data-parallelism is GSPMD: the batch axis is sharded over the mesh, params
-  replicated — XLA inserts the gradient all-reduce and the cross-device
-  quantile collective (reference `fabric.all_gather` in Moments).
+- data-parallelism is `shard_map` over the 1-D ``"data"`` mesh axis: the
+  batch enters sharded ``P(None, "data")`` (time × **sharded batch**), params
+  replicated; the three gradient pytrees are explicitly `lax.pmean`-reduced
+  before their optimizer updates and the Moments quantile runs on the
+  `lax.all_gather`-ed lambda values (reference `fabric.all_gather` in
+  Moments, utils.py:56-64).  Per-device batch math: each device computes
+  ``per_rank_batch_size`` of the staged ``per_rank_batch_size * world_size``
+  sequences, so adding devices scales global batch exactly like reference
+  DDP ranks.
 """
 
 from __future__ import annotations
@@ -48,6 +54,7 @@ from sheeprl_tpu.ops.distributions import (
     TwoHotEncodingDistribution,
 )
 from sheeprl_tpu.ops.numerics import compute_lambda_values
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -56,14 +63,24 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
 def make_train_step(
-    world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim: Sequence[int], is_continuous: bool
+    world_model_def,
+    actor_def,
+    critic_def,
+    optimizers,
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    mesh=None,
 ):
     """Build the jitted single-gradient-step update.
 
     Signature: (params, opt_states, moments_state, batch, key, tau) ->
     (params, opt_states, moments_state, metrics_vec).
     ``batch`` leaves are [T, B, ...] float arrays (pixels already in [-0.5, .5]).
+    With a >1-device ``mesh`` the step is shard_map'd: B is sharded over
+    ``"data"``, grads pmean'd, Moments quantiles all-gathered.
     """
+    axis = dp_axis(mesh)
     wm_cfg = cfg.algo.world_model
     stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
     recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
@@ -74,6 +91,7 @@ def make_train_step(
 
     def train_step(params, opt_states, moments_state, batch, key, tau):
         T, B = batch["actions"].shape[:2]
+        key = fold_key(key, axis)
         k_wm, k_img, k_img_actions = jax.random.split(key, 3)
 
         # --- target critic Polyak update (reference dreamer_v3.py:713-720) --
@@ -149,6 +167,7 @@ def make_train_step(
             return rec_loss, aux
 
         (rec_loss, aux), wm_grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(params["world_model"])
+        wm_grads = pmean_tree(wm_grads, axis)
         updates, opt_states["world_model"] = optimizers["world_model"].update(
             wm_grads, opt_states["world_model"], params["world_model"]
         )
@@ -208,6 +227,7 @@ def make_train_step(
                 cfg.algo.actor.moments.max,
                 cfg.algo.actor.moments.percentile.low,
                 cfg.algo.actor.moments.percentile.high,
+                axis_name=axis,
             )
             normed_lambda_values = (lambda_values - offset) / invscale
             normed_baseline = (baseline - offset) / invscale
@@ -235,6 +255,7 @@ def make_train_step(
         (policy_loss, aux2), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
             params["actor"], moments_state
         )
+        actor_grads = pmean_tree(actor_grads, axis)
         updates, opt_states["actor"] = optimizers["actor"].update(
             actor_grads, opt_states["actor"], params["actor"]
         )
@@ -258,6 +279,7 @@ def make_train_step(
             return jnp.mean(value_loss * discount[:-1, ..., 0])
 
         value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+        critic_grads = pmean_tree(critic_grads, axis)
         updates, opt_states["critic"] = optimizers["critic"].update(
             critic_grads, opt_states["critic"], params["critic"]
         )
@@ -278,9 +300,16 @@ def make_train_step(
                 optax.global_norm(critic_grads),
             ]
         )
+        metrics = pmean_tree(metrics, axis)
         return params, opt_states, moments_state, metrics
 
-    return jax.jit(train_step, donate_argnums=(0, 1, 2))
+    return dp_jit(
+        train_step,
+        mesh,
+        in_specs=(P(), P(), P(), batch_spec(batch_axis=1), P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        donate_argnums=(0, 1, 2),
+    )
 
 
 METRIC_ORDER = [
@@ -407,7 +436,14 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
         moments_state = jax.device_put(moments_state, replicated_sharding(runtime.mesh))
 
     train_step = make_train_step_fn(
-        world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, is_continuous
+        world_model_def,
+        actor_def,
+        critic_def,
+        optimizers,
+        cfg,
+        actions_dim,
+        is_continuous,
+        mesh=runtime.mesh if world_size > 1 else None,
     )
 
     buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 2
@@ -568,9 +604,16 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
                             tau = 1.0 if cumulative_grad_steps == 0 else cfg.algo.critic.tau
                         else:
                             tau = 0.0
+                        # stage [T, B_total, ...] with B sharded over the mesh
+                        # (raw dtype over PCIe; cast/normalize run sharded)
+                        staged = stage(
+                            {k: np.asarray(v[i]) for k, v in local_data.items()},
+                            runtime.mesh if world_size > 1 else None,
+                            batch_axis=1,
+                        )
                         batch = {}
-                        for k, v in local_data.items():
-                            arr = jnp.asarray(np.asarray(v[i]), jnp.float32)
+                        for k, arr in staged.items():
+                            arr = arr.astype(jnp.float32)
                             if k in cnn_keys:
                                 arr = arr / 255.0 - 0.5
                             batch[k] = arr

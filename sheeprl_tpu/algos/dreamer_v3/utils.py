@@ -3,8 +3,9 @@
 ``Moments`` is a pure-functional EMA of return percentiles: carried as a tiny
 state pytree updated inside the jitted train step.  The reference gathers
 values across ranks via ``fabric.all_gather`` before the quantile
-(utils.py:56-64); under single-controller GSPMD the quantile over the
-batch-sharded array already induces the cross-device collective.
+(utils.py:56-64); inside the shard_map'd train step the same semantics is an
+explicit ``lax.all_gather`` over the data axis before ``jnp.quantile``
+(``axis_name`` below), so every device EMAs the *global* percentiles.
 """
 
 from __future__ import annotations
@@ -46,10 +47,14 @@ def update_moments(
     max_: float = 1.0,
     percentile_low: float = 0.05,
     percentile_high: float = 0.95,
+    axis_name: str | None = None,
 ) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
     """Return (offset, invscale, new_state) (reference Moments.forward,
-    utils.py:56-64)."""
-    x = jax.lax.stop_gradient(x).astype(jnp.float32)
+    utils.py:56-64).  With ``axis_name`` set (inside shard_map) the quantile
+    is computed over the all-gathered values from every device."""
+    from sheeprl_tpu.parallel.dp import all_gather_cat
+
+    x = all_gather_cat(jax.lax.stop_gradient(x).astype(jnp.float32), axis_name)
     low = jnp.quantile(x, percentile_low)
     high = jnp.quantile(x, percentile_high)
     new_low = decay * state["low"] + (1 - decay) * low

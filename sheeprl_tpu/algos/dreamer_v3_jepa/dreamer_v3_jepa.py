@@ -31,6 +31,7 @@ from sheeprl_tpu.ops.distributions import (
     TwoHotEncodingDistribution,
 )
 from sheeprl_tpu.ops.numerics import compute_lambda_values
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree
 from sheeprl_tpu.utils.registry import register_algorithm
 
 _HEADS = {}  # filled by the wrapped build_agent; keyed per-process (single controller)
@@ -45,8 +46,16 @@ def _build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, *states):
 
 
 def make_train_step(
-    world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim: Sequence[int], is_continuous: bool
+    world_model_def,
+    actor_def,
+    critic_def,
+    optimizers,
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    mesh=None,
 ):
+    axis = dp_axis(mesh)
     wm_cfg = cfg.algo.world_model
     stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
     recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
@@ -63,6 +72,7 @@ def make_train_step(
 
     def train_step(params, opt_states, moments_state, batch, key, tau):
         T, B = batch["actions"].shape[:2]
+        key = fold_key(key, axis)
         k_wm, k_img, k_img_actions, k_views = jax.random.split(key, 4)
 
         params["target_critic"] = jax.tree_util.tree_map(
@@ -155,6 +165,7 @@ def make_train_step(
         (total_loss, aux), grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(
             (params["world_model"], jepa_online)
         )
+        grads = pmean_tree(grads, axis)
         updates, opt_states["world_model"] = optimizers["world_model"].update(
             grads, opt_states["world_model"], (params["world_model"], jepa_online)
         )
@@ -224,6 +235,7 @@ def make_train_step(
                 cfg.algo.actor.moments.max,
                 cfg.algo.actor.moments.percentile.low,
                 cfg.algo.actor.moments.percentile.high,
+                axis_name=axis,
             )
             advantage = (lambda_values - offset) / invscale - (baseline - offset) / invscale
             log_probs, entropies = actor_def.apply(
@@ -249,6 +261,7 @@ def make_train_step(
         (policy_loss, aux2), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
             params["actor"], moments_state
         )
+        actor_grads = pmean_tree(actor_grads, axis)
         updates, opt_states["actor"] = optimizers["actor"].update(
             actor_grads, opt_states["actor"], params["actor"]
         )
@@ -269,6 +282,7 @@ def make_train_step(
             return jnp.mean(value_loss * discount[:-1, ..., 0])
 
         value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+        critic_grads = pmean_tree(critic_grads, axis)
         updates, opt_states["critic"] = optimizers["critic"].update(
             critic_grads, opt_states["critic"], params["critic"]
         )
@@ -289,9 +303,16 @@ def make_train_step(
                 optax.global_norm(critic_grads),
             ]
         )
+        metrics = pmean_tree(metrics, axis)
         return params, opt_states, moments_state, metrics
 
-    return jax.jit(train_step, donate_argnums=(0, 1, 2))
+    return dp_jit(
+        train_step,
+        mesh,
+        in_specs=(P(), P(), P(), batch_spec(batch_axis=1), P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        donate_argnums=(0, 1, 2),
+    )
 
 
 def _extra_opt_setup(optimizers, opt_states, params):

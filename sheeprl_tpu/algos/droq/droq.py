@@ -29,6 +29,7 @@ from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -36,13 +37,15 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
-def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: float):
+def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: float, mesh=None):
+    axis = dp_axis(mesh)
     tau = cfg.algo.tau
     gamma = cfg.algo.gamma
 
     def one_step(carry, inp):
         params, opt_states = carry
         batch, actor_batch, key = inp
+        key = fold_key(key, axis)
         k_next, k_drop, k_actor, k_drop2 = jax.random.split(key, 4)
 
         # --- critic update (reference droq.py:95-120) ---------------------
@@ -70,6 +73,7 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: floa
             return jnp.sum(jnp.mean((qf_values - next_qf_value) ** 2, axis=tuple(range(qf_values.ndim - 1))))
 
         qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
+        qf_grads = pmean_tree(qf_grads, axis)
         updates, opt_states["critic"] = optimizers["critic"].update(
             qf_grads, opt_states["critic"], params["critic"]
         )
@@ -89,6 +93,7 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: floa
             return policy_loss(alpha, logprobs, mean_q), logprobs
 
         (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
+        actor_grads = pmean_tree(actor_grads, axis)
         updates, opt_states["actor"] = optimizers["actor"].update(
             actor_grads, opt_states["actor"], params["actor"]
         )
@@ -99,6 +104,7 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: floa
             return entropy_loss(log_alpha, logprobs, target_entropy)
 
         alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"])
+        alpha_grads = pmean_tree(alpha_grads, axis)
         updates, opt_states["alpha"] = optimizers["alpha"].update(
             alpha_grads, opt_states["alpha"], params["log_alpha"]
         )
@@ -108,9 +114,15 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: floa
 
     def update(params, opt_states, data, actor_data, keys):
         (params, opt_states), losses = jax.lax.scan(one_step, (params, opt_states), (data, actor_data, keys))
-        return params, opt_states, jnp.mean(losses, axis=0)
+        return params, opt_states, pmean_tree(jnp.mean(losses, axis=0), axis)
 
-    return jax.jit(update, donate_argnums=(0, 1))
+    return dp_jit(
+        update,
+        mesh,
+        in_specs=(P(), P(), batch_spec(batch_axis=1), batch_spec(batch_axis=1), P()),
+        out_specs=(P(), P(), P()),
+        donate_argnums=(0, 1),
+    )
 
 
 @register_algorithm()
@@ -162,7 +174,9 @@ def main(runtime, cfg):
             state["opt_states"],
         )
 
-    train_step = make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy)
+    train_step = make_train_step(
+        actor_def, critic_def, optimizers, cfg, target_entropy, mesh=runtime.mesh if world_size > 1 else None
+    )
 
     @jax.jit
     def policy_step(actor_params, obs, key):
@@ -250,12 +264,19 @@ def main(runtime, cfg):
                     G = per_rank_gradient_steps
                     sample = rb.sample(batch_size=batch_size * world_size, n_samples=G)
                     actor_sample = rb.sample(batch_size=batch_size * world_size, n_samples=G)
-                    data = {
-                        k: jnp.asarray(np.asarray(v), jnp.float32)
-                        for k, v in sample.items()
-                        if k in ("observations", "next_observations", "actions", "rewards", "terminated")
-                    }
-                    actor_data = {"observations": jnp.asarray(np.asarray(actor_sample["observations"]), jnp.float32)}
+                    dp_mesh = runtime.mesh if world_size > 1 else None
+                    data = stage(
+                        {
+                            k: np.asarray(v, np.float32)
+                            for k, v in sample.items()
+                            if k in ("observations", "next_observations", "actions", "rewards", "terminated")
+                        },
+                        dp_mesh,
+                        batch_axis=1,
+                    )
+                    actor_data = stage(
+                        {"observations": np.asarray(actor_sample["observations"], np.float32)}, dp_mesh, batch_axis=1
+                    )
                     rng_key, scan_key = jax.random.split(rng_key)
                     keys = jax.random.split(scan_key, G)
                     params, opt_states, losses = train_step(params, opt_states, data, actor_data, keys)

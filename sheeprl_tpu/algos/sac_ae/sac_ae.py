@@ -33,6 +33,7 @@ from sheeprl_tpu.algos.sac_ae.utils import (  # noqa: F401
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -40,7 +41,8 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
-def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers, cfg, target_entropy):
+def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers, cfg, target_entropy, mesh=None):
+    axis = dp_axis(mesh)
     gamma = cfg.algo.gamma
     tau = cfg.algo.tau
     encoder_tau = cfg.algo.encoder.tau
@@ -56,6 +58,7 @@ def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers,
     def one_step(carry, inp):
         params, opt_states, counter = carry
         batch, key = inp
+        key = fold_key(key, axis)
         k_next, k_actor, k_noise = jax.random.split(key, 3)
 
         obs = {k: batch[k] / 255.0 for k in cnn_keys}
@@ -87,6 +90,7 @@ def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers,
         qf_l, (enc_grads, critic_grads) = jax.value_and_grad(qf_loss_fn)(
             (params["encoder"], params["critic"])
         )
+        enc_grads, critic_grads = pmean_tree((enc_grads, critic_grads), axis)
         updates, opt_states["critic"] = optimizers["critic"].update(
             (enc_grads, critic_grads), opt_states["critic"], (params["encoder"], params["critic"])
         )
@@ -123,6 +127,7 @@ def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers,
             (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
                 params["actor"]
             )
+            actor_grads = pmean_tree(actor_grads, axis)
             updates, opt_states["actor"] = optimizers["actor"].update(
                 actor_grads, opt_states["actor"], params["actor"]
             )
@@ -132,6 +137,7 @@ def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers,
                 return entropy_loss(log_alpha, logprobs, target_entropy)
 
             alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"])
+            alpha_grads = pmean_tree(alpha_grads, axis)
             updates, opt_states["alpha"] = optimizers["alpha"].update(
                 alpha_grads, opt_states["alpha"], params["log_alpha"]
             )
@@ -169,6 +175,7 @@ def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers,
             rec_l, (enc_grads, dec_grads) = jax.value_and_grad(rec_loss_fn)(
                 (params["encoder"], params["decoder"])
             )
+            enc_grads, dec_grads = pmean_tree((enc_grads, dec_grads), axis)
             updates, opt_states["encoder"] = optimizers["encoder"].update(
                 enc_grads, opt_states["encoder"], params["encoder"]
             )
@@ -193,9 +200,15 @@ def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers,
         (params, opt_states, counter), losses = jax.lax.scan(
             one_step, (params, opt_states, counter), (data, keys)
         )
-        return params, opt_states, counter, jnp.mean(losses, axis=0)
+        return params, opt_states, counter, pmean_tree(jnp.mean(losses, axis=0), axis)
 
-    return jax.jit(update, donate_argnums=(0, 1))
+    return dp_jit(
+        update,
+        mesh,
+        in_specs=(P(), P(), P(), batch_spec(batch_axis=1), P()),
+        out_specs=(P(), P(), P(), P()),
+        donate_argnums=(0, 1),
+    )
 
 
 @register_algorithm()
@@ -255,7 +268,14 @@ def main(runtime, cfg):
         )
 
     train_step = make_train_step(
-        encoder_def, decoder_def, actor_def, critic_def, optimizers, cfg, target_entropy
+        encoder_def,
+        decoder_def,
+        actor_def,
+        critic_def,
+        optimizers,
+        cfg,
+        target_entropy,
+        mesh=runtime.mesh if world_size > 1 else None,
     )
 
     @jax.jit
@@ -344,7 +364,11 @@ def main(runtime, cfg):
                         n_samples=per_rank_gradient_steps,
                         sample_next_obs=True,
                     )
-                    data = {k: jnp.asarray(np.asarray(v), jnp.float32) for k, v in sample.items()}
+                    data = stage(
+                        {k: np.asarray(v, np.float32) for k, v in sample.items()},
+                        runtime.mesh if world_size > 1 else None,
+                        batch_axis=1,
+                    )
                     rng_key, scan_key = jax.random.split(rng_key)
                     keys = jax.random.split(scan_key, per_rank_gradient_steps)
                     params, opt_states, cumulative_counter, losses = train_step(
