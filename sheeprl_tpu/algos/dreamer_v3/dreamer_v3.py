@@ -327,18 +327,104 @@ METRIC_ORDER = [
 ]
 
 
+def _build_agent_from_state(runtime, actions_dim, is_continuous, cfg, obs_space, state):
+    return build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        state["world_model"] if state else None,
+        state["actor"] if state else None,
+        state["critic"] if state else None,
+        state["target_critic"] if state else None,
+    )
+
+
 @register_algorithm()
 def main(runtime, cfg):
-    return _dreamer_main(runtime, cfg, build_agent, make_train_step)
+    return _dreamer_main(runtime, cfg, _build_agent_from_state, make_train_step)
 
 
-def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_setup=None):
-    """Shared DV3-family loop; the JEPA variant swaps in its own agent
-    builder and train step (algos/dreamer_v3_jepa)."""
+def _default_make_optimizers(cfg, params, agent_state, extra_opt_setup=None):
+    """DV3's three optimizers (world/actor/critic) with generic restore."""
+    optimizers = {
+        "world_model": optax.chain(
+            optax.clip_by_global_norm(cfg.algo.world_model.clip_gradients),
+            instantiate(cfg.algo.world_model.optimizer),
+        ),
+        "actor": optax.chain(
+            optax.clip_by_global_norm(cfg.algo.actor.clip_gradients),
+            instantiate(cfg.algo.actor.optimizer),
+        ),
+        "critic": optax.chain(
+            optax.clip_by_global_norm(cfg.algo.critic.clip_gradients),
+            instantiate(cfg.algo.critic.optimizer),
+        ),
+    }
+    opt_states = {
+        "world_model": optimizers["world_model"].init(params["world_model"]),
+        "actor": optimizers["actor"].init(params["actor"]),
+        "critic": optimizers["critic"].init(params["critic"]),
+    }
+    if extra_opt_setup is not None:
+        opt_states = extra_opt_setup(optimizers, opt_states, params)
+    if agent_state and "opt_states" in agent_state:
+        opt_states = jax.tree_util.tree_map(
+            lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
+            opt_states,
+            agent_state["opt_states"],
+        )
+    return optimizers, opt_states
+
+
+def _dreamer_main(
+    runtime,
+    cfg,
+    build_agent_fn,
+    make_train_step_fn,
+    extra_opt_setup=None,
+    *,
+    make_optimizers_fn=None,
+    init_moments_fn=None,
+    player_actor_fn=None,
+    metric_order=None,
+    final_test_fn=None,
+    load_agent_state_fn=None,
+    player_cls=PlayerDV3,
+):
+    """Shared Dreamer-family training engine.
+
+    The DV3/DV1-style loop (env interaction + sequential replay + jitted
+    train step + checkpoint) parameterized by hooks so the JEPA variant and
+    the Plan2Explore exploration/finetuning entrypoints reuse it:
+
+    - ``build_agent_fn(runtime, actions_dim, is_continuous, cfg, obs_space,
+      agent_state)`` -> ``(wm_def, actor_def, critic_def, params)`` — params
+      may carry extra keys (JEPA heads, P2E ensembles/critics); every key is
+      checkpointed.
+    - ``make_optimizers_fn(cfg, params, agent_state)`` -> ``(optimizers,
+      opt_states)``; default = DV3's world/actor/critic trio.
+    - ``init_moments_fn(cfg, agent_state)`` -> Moments pytree (P2E: a dict of
+      task + per-exploration-critic states).
+    - ``player_actor_fn(params, has_trained)`` -> actor params for env
+      interaction (P2E exploration plays with ``actor_exploration``;
+      finetuning switches exploration -> task at the first gradient step,
+      reference p2e_dv3_finetuning.py:350-354).
+    - ``final_test_fn(player, params, runtime, cfg, log_dir)`` -> reward
+      (P2E: zero-shot test with the task actor).
+    - ``load_agent_state_fn(runtime, cfg)`` -> state used to *initialize*
+      models when not resuming (finetuning loads the exploration checkpoint,
+      reference cli.py:117-148); counters/buffers restore only from
+      ``checkpoint.resume_from``.
+    """
     world_size = runtime.world_size
     num_envs = cfg.env.num_envs
 
     state = runtime.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    agent_state = state
+    if agent_state is None and load_agent_state_fn is not None:
+        agent_state = load_agent_state_fn(runtime, cfg)
 
     cfg.env.frame_stack = -1
     if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
@@ -385,48 +471,24 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
     obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
 
     world_model_def, actor_def, critic_def, params = build_agent_fn(
-        runtime,
-        actions_dim,
-        is_continuous,
-        cfg,
-        observation_space,
-        state["world_model"] if state else None,
-        state["actor"] if state else None,
-        state["critic"] if state else None,
-        state["target_critic"] if state else None,
+        runtime, actions_dim, is_continuous, cfg, observation_space, agent_state
     )
-    player = PlayerDV3(world_model_def, actor_def, actions_dim, num_envs)
+    player = player_cls(world_model_def, actor_def, actions_dim, num_envs)
 
-    optimizers = {
-        "world_model": optax.chain(
-            optax.clip_by_global_norm(cfg.algo.world_model.clip_gradients),
-            instantiate(cfg.algo.world_model.optimizer),
-        ),
-        "actor": optax.chain(
-            optax.clip_by_global_norm(cfg.algo.actor.clip_gradients),
-            instantiate(cfg.algo.actor.optimizer),
-        ),
-        "critic": optax.chain(
-            optax.clip_by_global_norm(cfg.algo.critic.clip_gradients),
-            instantiate(cfg.algo.critic.optimizer),
-        ),
-    }
-    opt_states = {
-        "world_model": optimizers["world_model"].init(params["world_model"]),
-        "actor": optimizers["actor"].init(params["actor"]),
-        "critic": optimizers["critic"].init(params["critic"]),
-    }
-    if extra_opt_setup is not None:
-        opt_states = extra_opt_setup(optimizers, opt_states, params)
-    if state and "opt_states" in state:
-        opt_states = jax.tree_util.tree_map(
-            lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
-            opt_states,
-            state["opt_states"],
-        )
-    moments_state = init_moments_state()
-    if state and "moments" in state:
-        moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
+    if make_optimizers_fn is None:
+        optimizers, opt_states = _default_make_optimizers(cfg, params, agent_state, extra_opt_setup)
+    else:
+        optimizers, opt_states = make_optimizers_fn(cfg, params, agent_state)
+    if init_moments_fn is None:
+        moments_state = init_moments_state()
+        if agent_state and "moments" in agent_state:
+            moments_state = jax.tree_util.tree_map(jnp.asarray, agent_state["moments"])
+    else:
+        moments_state = init_moments_fn(cfg, agent_state)
+    if player_actor_fn is None:
+        player_actor_fn = lambda p, has_trained: p["actor"]  # noqa: E731
+    if metric_order is None:
+        metric_order = METRIC_ORDER
 
     from sheeprl_tpu.parallel.mesh import replicated_sharding
 
@@ -455,8 +517,17 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
         memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
         buffer_cls=SequentialReplayBuffer,
     )
-    if state and cfg.buffer.checkpoint and "rb" in state and state["rb"] is not None:
-        rb.load_state_dict(state["rb"])
+    buffer_state = state
+    if buffer_state is None and cfg.buffer.get("load_from_exploration") and agent_state:
+        # P2E finetuning may continue on the exploration replay buffer
+        # (reference p2e_dv3_finetuning.py:188-195)
+        buffer_state = agent_state
+    if (
+        buffer_state
+        and (cfg.buffer.checkpoint or cfg.buffer.get("load_from_exploration"))
+        and buffer_state.get("rb") is not None
+    ):
+        rb.load_state_dict(buffer_state["rb"])
 
     train_step_count = 0
     last_train = 0
@@ -490,6 +561,7 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
     cumulative_grad_steps = 0
+    has_trained = bool(cfg.checkpoint.resume_from)
 
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
@@ -509,7 +581,7 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
                 rng_key, step_key = jax.random.split(rng_key)
                 torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
                 actions_jnp = player.get_actions(
-                    params["world_model"], params["actor"], torch_obs, step_key
+                    params["world_model"], player_actor_fn(params, has_trained), torch_obs, step_key
                 )
                 actions = np.asarray(actions_jnp)
                 if is_continuous:
@@ -593,6 +665,7 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
             if cfg.dry_run:
                 per_rank_gradient_steps = 1
             if per_rank_gradient_steps > 0:
+                has_trained = True
                 local_data = rb.sample(
                     cfg.algo.per_rank_batch_size * world_size,
                     sequence_length=cfg.algo.per_rank_sequence_length,
@@ -600,8 +673,9 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
                 )
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
-                        if cumulative_grad_steps % cfg.algo.critic.per_rank_target_network_update_freq == 0:
-                            tau = 1.0 if cumulative_grad_steps == 0 else cfg.algo.critic.tau
+                        target_freq = cfg.algo.critic.get("per_rank_target_network_update_freq", 0)
+                        if target_freq and cumulative_grad_steps % target_freq == 0:
+                            tau = 1.0 if cumulative_grad_steps == 0 else cfg.algo.critic.get("tau", 1.0)
                         else:
                             tau = 0.0
                         # stage [T, B_total, ...] with B sharded over the mesh
@@ -624,7 +698,7 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
                         cumulative_grad_steps += 1
                     train_step_count += 1
                 metrics = np.asarray(metrics)
-                for name, value in zip(METRIC_ORDER, metrics):
+                for name, value in zip(metric_order, metrics):
                     aggregator.update(name, float(value))
 
         # ---- log (reference dreamer_v3.py:747-793) ------------------------
@@ -654,15 +728,7 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
         ):
             last_checkpoint = policy_step_count
             ckpt_state = {
-                "world_model": jax.tree_util.tree_map(np.asarray, params["world_model"]),
-                "actor": jax.tree_util.tree_map(np.asarray, params["actor"]),
-                "critic": jax.tree_util.tree_map(np.asarray, params["critic"]),
-                "target_critic": jax.tree_util.tree_map(np.asarray, params["target_critic"]),
-                **{
-                    k: jax.tree_util.tree_map(np.asarray, v)
-                    for k, v in params.items()
-                    if k not in ("world_model", "actor", "critic", "target_critic")
-                },
+                **{k: jax.tree_util.tree_map(np.asarray, v) for k, v in params.items()},
                 "opt_states": jax.tree_util.tree_map(np.asarray, opt_states),
                 "moments": jax.tree_util.tree_map(np.asarray, moments_state),
                 "ratio": ratio.state_dict(),
@@ -681,12 +747,15 @@ def _dreamer_main(runtime, cfg, build_agent_fn, make_train_step_fn, extra_opt_se
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
-        cumulative_rew = test(
-            player, params["world_model"], params["actor"], runtime, cfg, log_dir, greedy=False
-        )
+        if final_test_fn is None:
+            cumulative_rew = test(
+                player, params["world_model"], player_actor_fn(params, True), runtime, cfg, log_dir, greedy=False
+            )
+        else:
+            cumulative_rew = final_test_fn(player, params, runtime, cfg, log_dir)
         logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, policy_step_count)
     if cfg.model_manager.disabled is False and runtime.is_global_zero:  # pragma: no cover
         from sheeprl_tpu.utils.mlflow import log_models
 
-        log_models(cfg, {"world_model": params["world_model"], "actor": params["actor"]}, log_dir)
+        log_models(cfg, params, log_dir)
     logger.finalize()

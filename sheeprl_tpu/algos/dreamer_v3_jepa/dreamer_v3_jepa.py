@@ -37,11 +37,23 @@ from sheeprl_tpu.utils.registry import register_algorithm
 _HEADS = {}  # filled by the wrapped build_agent; keyed per-process (single controller)
 
 
-def _build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, *states):
+def _build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, state):
     world_model_def, actor_def, critic_def, head_defs, params = _build_agent_full(
-        runtime, actions_dim, is_continuous, cfg, obs_space, *states
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        state["world_model"] if state else None,
+        state["actor"] if state else None,
+        state["critic"] if state else None,
+        state["target_critic"] if state else None,
     )
     _HEADS["projector_def"], _HEADS["predictor_def"] = head_defs
+    if state and "jepa" in state:
+        import jax as _jax
+
+        params["jepa"] = _jax.tree_util.tree_map(jnp.asarray, state["jepa"])
     return world_model_def, actor_def, critic_def, params
 
 

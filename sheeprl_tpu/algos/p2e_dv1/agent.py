@@ -1,0 +1,93 @@
+"""Plan2Explore-DV1 agent (reference /root/reference/sheeprl/algos/p2e_dv1/agent.py:27-155).
+
+DreamerV1 stack + exploration actor, a single exploration critic (DV1 has no
+target critics), and a vmapped ensemble predicting the **embedded
+observation** at t+1 from ``(posterior, recurrent, action)``
+(reference agent.py:125-145, output dim = encoder output size)."""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v1.agent import build_agent as dv1_build_agent
+from sheeprl_tpu.algos.p2e_dv3.agent import Ensemble
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    ensembles_state: Optional[Dict[str, Any]] = None,
+    actor_task_state: Optional[Dict[str, Any]] = None,
+    critic_task_state: Optional[Dict[str, Any]] = None,
+    actor_exploration_state: Optional[Dict[str, Any]] = None,
+    critic_exploration_state: Optional[Dict[str, Any]] = None,
+):
+    """Returns ``(world_model_def, actor_def, critic_def, ensemble_def,
+    params)`` with params keys: world_model, actor_task, critic_task,
+    actor_exploration, critic_exploration, ensembles."""
+    world_model_def, actor_def, critic_def, dv1_params = dv1_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+    )
+    wm_cfg = cfg.algo.world_model
+    latent_state_size = wm_cfg.stochastic_size + wm_cfg.recurrent_model.recurrent_state_size
+
+    key = jax.random.PRNGKey(int(cfg.seed or 0) + 41)
+    k_actor, k_critic, k_ens = jax.random.split(key, 3)
+    sample_latent = jnp.zeros((1, latent_state_size), jnp.float32)
+
+    actor_exploration_params = actor_def.init(k_actor, sample_latent)
+    if actor_exploration_state is not None:
+        actor_exploration_params = jax.tree_util.tree_map(jnp.asarray, actor_exploration_state)
+    critic_exploration_params = critic_def.init(k_critic, sample_latent)
+    if critic_exploration_state is not None:
+        critic_exploration_params = jax.tree_util.tree_map(jnp.asarray, critic_exploration_state)
+
+    # probe the encoder output dim — the ensemble target (reference
+    # agent.py:136: cnn_output_dim + mlp_output_dim)
+    sample_obs: Dict[str, jax.Array] = {}
+    for k in cfg.algo.cnn_keys.encoder:
+        sample_obs[k] = jnp.zeros((1,) + tuple(obs_space[k].shape), jnp.float32)
+    for k in cfg.algo.mlp_keys.encoder:
+        sample_obs[k] = jnp.zeros((1, int(prod(obs_space[k].shape))), jnp.float32)
+    embedded = world_model_def.apply(dv1_params["world_model"], sample_obs, method="encode")
+    embedding_size = int(embedded.shape[-1])
+
+    ens_cfg = cfg.algo.ensembles
+    ensemble_def = Ensemble(
+        output_dim=embedding_size,
+        dense_units=ens_cfg.dense_units,
+        mlp_layers=ens_cfg.mlp_layers,
+        layer_norm=False,
+        hafner_initialization=False,
+        act=cfg.algo.dense_act,
+    )
+    sample_in = jnp.zeros((1, latent_state_size + int(sum(actions_dim))), jnp.float32)
+    member_keys = jax.random.split(k_ens, int(ens_cfg.n))
+    ensembles_params = jax.vmap(lambda k: ensemble_def.init(k, sample_in))(member_keys)
+    if ensembles_state is not None:
+        ensembles_params = jax.tree_util.tree_map(jnp.asarray, ensembles_state)
+
+    params = {
+        "world_model": dv1_params["world_model"],
+        "actor_task": dv1_params["actor"],
+        "critic_task": dv1_params["critic"],
+        "actor_exploration": actor_exploration_params,
+        "critic_exploration": critic_exploration_params,
+        "ensembles": ensembles_params,
+    }
+    return world_model_def, actor_def, critic_def, ensemble_def, params

@@ -1,0 +1,52 @@
+"""P2E-DV1 evaluation (reference /root/reference/sheeprl/algos/p2e_dv1/evaluate.py):
+evaluates the task actor from an exploration or finetuning checkpoint."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.dreamer_v1.agent import PlayerDV1
+from sheeprl_tpu.algos.dreamer_v3.utils import test
+from sheeprl_tpu.algos.p2e_dv1.agent import build_agent
+from sheeprl_tpu.envs.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["p2e_dv1_exploration", "p2e_dv1_finetuning"])
+def evaluate_p2e_dv1(runtime, cfg, state: Dict[str, Any]) -> None:
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    action_space = env.action_space
+    observation_space = env.observation_space
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    is_finetune_ckpt = "actor" in state
+    world_model_def, actor_def, _, _, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"],
+        state.get("ensembles"),
+        state["actor"] if is_finetune_ckpt else state["actor_task"],
+        state["critic"] if is_finetune_ckpt else state["critic_task"],
+        state.get("actor_exploration"),
+        state.get("critic_exploration"),
+    )
+    player = PlayerDV1(world_model_def, actor_def, actions_dim, 1)
+    env.close()
+    cumulative_rew = test(
+        player, params["world_model"], params["actor_task"], runtime, cfg, log_dir, greedy=False
+    )
+    logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    logger.finalize()

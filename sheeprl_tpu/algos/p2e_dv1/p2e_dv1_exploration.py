@@ -1,0 +1,408 @@
+"""Plan2Explore-DV1 exploration (reference
+/root/reference/sheeprl/algos/p2e_dv1/p2e_dv1_exploration.py:40-801).
+
+DreamerV1 world-model learning + ensemble learning (next *observation
+embedding* prediction, reference :165-185) + exploration behaviour (dynamics
+backprop on intrinsic lambda values, :186-265) + zero-shot task behaviour,
+fused into one jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from sheeprl_tpu.algos.dreamer_v1.agent import PlayerDV1
+from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values
+from sheeprl_tpu.algos.dreamer_v2.loss import normal_log_prob
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _dreamer_main
+from sheeprl_tpu.algos.dreamer_v3.utils import test
+from sheeprl_tpu.algos.p2e_dv1.agent import build_agent
+from sheeprl_tpu.algos.p2e_dv1.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER  # noqa: F401
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.ops.distributions import Bernoulli
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree
+from sheeprl_tpu.utils.registry import register_algorithm
+
+_P2E = {"ensemble_def": None}
+
+METRIC_ORDER = [
+    "Loss/world_model_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "Loss/ensemble_loss",
+    "Loss/policy_loss_exploration",
+    "Loss/value_loss_exploration",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Rewards/intrinsic",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Grads/world_model",
+    "Grads/ensemble",
+    "Grads/actor_exploration",
+    "Grads/critic_exploration",
+    "Grads/actor_task",
+    "Grads/critic_task",
+]
+
+
+def make_train_step(
+    world_model_def,
+    actor_def,
+    critic_def,
+    optimizers,
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    mesh=None,
+):
+    axis = dp_axis(mesh)
+    ensemble_def = _P2E["ensemble_def"]
+    wm_cfg = cfg.algo.world_model
+    stochastic_size = wm_cfg.stochastic_size
+    recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
+    horizon = cfg.algo.horizon
+    gamma = cfg.algo.gamma
+    cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
+    use_continues = wm_cfg.use_continues
+    intrinsic_mult = cfg.algo.intrinsic_reward_multiplier
+
+    def ensembles_apply(ens_params, x):
+        return jax.vmap(lambda p: ensemble_def.apply(p, x))(ens_params)
+
+    def imagine(wm_params, actor_params, posteriors, recurrents, k_img):
+        """DV1 imagination: H imagined latents + the actions that produced
+        them (reference :186-205)."""
+        latent0 = jnp.concatenate([posteriors, recurrents], axis=-1)
+
+        def img_body(carry, key_t):
+            prior, recurrent, latent = carry
+            k_act, k_dyn = jax.random.split(key_t)
+            actions = actor_def.apply(actor_params, jax.lax.stop_gradient(latent), k_act, False, method="act")
+            prior, recurrent = world_model_def.apply(
+                wm_params, prior, recurrent, actions, k_dyn, method="imagination"
+            )
+            latent = jnp.concatenate([prior, recurrent], axis=-1)
+            return (prior, recurrent, latent), (latent, actions)
+
+        keys_h = jax.random.split(k_img, horizon)
+        _, (latents_h, actions_h) = jax.lax.scan(img_body, (posteriors, recurrents, latent0), keys_h)
+        return latents_h, actions_h  # [H, TB, ...]
+
+    def train_step(params, opt_states, moments_state, batch, key, tau):
+        del tau  # DV1 has no target critics
+        T, B = batch["actions"].shape[:2]
+        key = fold_key(key, axis)
+        k_wm, k_img_e, k_img_t = jax.random.split(key, 3)
+
+        batch_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}
+
+        # ---------------- DYNAMIC LEARNING (as DV1) ------------------------
+        def wm_loss_fn(wm_params):
+            embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
+
+            def scan_body(carry, x):
+                posterior, recurrent = carry
+                action_t, embed_t, key_t = x
+                recurrent, posterior, _, post_ms, prior_ms = world_model_def.apply(
+                    wm_params, posterior, recurrent, action_t, embed_t, key_t, method="dynamic"
+                )
+                return (posterior, recurrent), (recurrent, posterior, post_ms, prior_ms)
+
+            keys_t = jax.random.split(k_wm, T)
+            init = (jnp.zeros((B, stochastic_size)), jnp.zeros((B, recurrent_size)))
+            _, (recurrents, posteriors, post_ms, prior_ms) = jax.lax.scan(
+                scan_body, init, (batch["actions"], embedded, keys_t)
+            )
+            latents = jnp.concatenate([posteriors, recurrents], axis=-1)
+            recon = world_model_def.apply(wm_params, latents, method="decode")
+            reward_mean = world_model_def.apply(wm_params, latents, method="reward_logits")
+            if use_continues:
+                qc = Bernoulli(
+                    world_model_def.apply(wm_params, latents, method="continue_logits"), event_dims=1
+                )
+                continues_targets = (1 - batch["terminated"]) * gamma
+            else:
+                qc = continues_targets = None
+            rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+                recon,
+                batch_obs,
+                reward_mean,
+                batch["rewards"],
+                post_ms,
+                prior_ms,
+                wm_cfg.kl_free_nats,
+                wm_cfg.kl_regularizer,
+                qc,
+                continues_targets,
+                wm_cfg.continue_scale_factor,
+            )
+            aux = {
+                "posteriors": posteriors,
+                "recurrents": recurrents,
+                "embedded": embedded,
+                "kl": kl,
+                "state_loss": state_loss,
+                "reward_loss": reward_loss,
+                "observation_loss": observation_loss,
+                "continue_loss": continue_loss,
+            }
+            return rec_loss, aux
+
+        (rec_loss, aux), wm_grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(params["world_model"])
+        wm_grads = pmean_tree(wm_grads, axis)
+        updates, opt_states["world_model"] = optimizers["world_model"].update(
+            wm_grads, opt_states["world_model"], params["world_model"]
+        )
+        params["world_model"] = optax.apply_updates(params["world_model"], updates)
+        wm_params = params["world_model"]
+
+        posteriors = jax.lax.stop_gradient(aux["posteriors"])  # [T, B, S]
+        recurrents = jax.lax.stop_gradient(aux["recurrents"])
+        embedded = jax.lax.stop_gradient(aux["embedded"])  # [T, B, E]
+
+        # ---------------- ENSEMBLE LEARNING (reference :165-185) -----------
+        def ens_loss_fn(ens_params):
+            inp = jnp.concatenate([posteriors, recurrents, batch["actions"]], axis=-1)
+            outs = ensembles_apply(ens_params, inp)[:, :-1]  # [N, T-1, B, E]
+            target = jnp.broadcast_to(embedded[1:][None], outs.shape)
+            lp = normal_log_prob(outs, target, 1)
+            return -jnp.mean(lp, axis=(1, 2)).sum()
+
+        ens_loss, ens_grads = jax.value_and_grad(ens_loss_fn)(params["ensembles"])
+        ens_grads = pmean_tree(ens_grads, axis)
+        updates, opt_states["ensembles"] = optimizers["ensembles"].update(
+            ens_grads, opt_states["ensembles"], params["ensembles"]
+        )
+        params["ensembles"] = optax.apply_updates(params["ensembles"], updates)
+
+        flat_post = posteriors.reshape(T * B, stochastic_size)
+        flat_rec = recurrents.reshape(T * B, recurrent_size)
+
+        # ---------------- EXPLORATION BEHAVIOUR (reference :186-265) -------
+        def actor_expl_loss_fn(actor_params):
+            trajectories, actions = imagine(wm_params, actor_params, flat_post, flat_rec, k_img_e)
+            values = critic_def.apply(params["critic_exploration"], trajectories)
+
+            ens_in = jax.lax.stop_gradient(jnp.concatenate([trajectories, actions], axis=-1))
+            preds = ensembles_apply(params["ensembles"], ens_in)  # [N, H, TB, E]
+            intrinsic_reward = (
+                jnp.var(preds, axis=0, ddof=1).mean(-1, keepdims=True) * intrinsic_mult
+            )
+            if use_continues:
+                continues = jax.nn.sigmoid(
+                    world_model_def.apply(wm_params, trajectories, method="continue_logits")
+                )
+            else:
+                continues = jnp.ones_like(jax.lax.stop_gradient(intrinsic_reward)) * gamma
+
+            lambda_values = compute_lambda_values(
+                intrinsic_reward,
+                values,
+                continues,
+                last_values=values[-1],
+                horizon=horizon,
+                lmbda=cfg.algo.lmbda,
+            )
+            discount = jax.lax.stop_gradient(
+                jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], axis=0), axis=0)
+            )
+            loss = -jnp.mean(discount * lambda_values)
+            aux2 = {
+                "trajectories": jax.lax.stop_gradient(trajectories),
+                "lambda_values": jax.lax.stop_gradient(lambda_values),
+                "discount": discount,
+                "intrinsic_reward": jnp.mean(jax.lax.stop_gradient(intrinsic_reward)),
+                "predicted_values": jnp.mean(jax.lax.stop_gradient(values)),
+            }
+            return loss, aux2
+
+        (policy_loss_expl, aux_e), actor_expl_grads = jax.value_and_grad(actor_expl_loss_fn, has_aux=True)(
+            params["actor_exploration"]
+        )
+        actor_expl_grads = pmean_tree(actor_expl_grads, axis)
+        updates, opt_states["actor_exploration"] = optimizers["actor_exploration"].update(
+            actor_expl_grads, opt_states["actor_exploration"], params["actor_exploration"]
+        )
+        params["actor_exploration"] = optax.apply_updates(params["actor_exploration"], updates)
+
+        def critic_expl_loss_fn(critic_params):
+            values = critic_def.apply(critic_params, aux_e["trajectories"])[:-1]
+            lp = normal_log_prob(values, aux_e["lambda_values"], 1)
+            return -jnp.mean(aux_e["discount"][..., 0] * lp)
+
+        value_loss_expl, critic_expl_grads = jax.value_and_grad(critic_expl_loss_fn)(
+            params["critic_exploration"]
+        )
+        critic_expl_grads = pmean_tree(critic_expl_grads, axis)
+        updates, opt_states["critic_exploration"] = optimizers["critic_exploration"].update(
+            critic_expl_grads, opt_states["critic_exploration"], params["critic_exploration"]
+        )
+        params["critic_exploration"] = optax.apply_updates(params["critic_exploration"], updates)
+
+        # ---------------- TASK BEHAVIOUR (zero-shot, as DV1) ---------------
+        def actor_task_loss_fn(actor_params):
+            trajectories, _ = imagine(wm_params, actor_params, flat_post, flat_rec, k_img_t)
+            values = critic_def.apply(params["critic_task"], trajectories)
+            rewards = world_model_def.apply(wm_params, trajectories, method="reward_logits")
+            if use_continues:
+                continues = jax.nn.sigmoid(
+                    world_model_def.apply(wm_params, trajectories, method="continue_logits")
+                )
+            else:
+                continues = jnp.ones_like(jax.lax.stop_gradient(rewards)) * gamma
+            lambda_values = compute_lambda_values(
+                rewards,
+                values,
+                continues,
+                last_values=values[-1],
+                horizon=horizon,
+                lmbda=cfg.algo.lmbda,
+            )
+            discount = jax.lax.stop_gradient(
+                jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], axis=0), axis=0)
+            )
+            loss = -jnp.mean(discount * lambda_values)
+            aux3 = {
+                "trajectories": jax.lax.stop_gradient(trajectories),
+                "lambda_values": jax.lax.stop_gradient(lambda_values),
+                "discount": discount,
+            }
+            return loss, aux3
+
+        (policy_loss_task, aux_t), actor_task_grads = jax.value_and_grad(actor_task_loss_fn, has_aux=True)(
+            params["actor_task"]
+        )
+        actor_task_grads = pmean_tree(actor_task_grads, axis)
+        updates, opt_states["actor_task"] = optimizers["actor_task"].update(
+            actor_task_grads, opt_states["actor_task"], params["actor_task"]
+        )
+        params["actor_task"] = optax.apply_updates(params["actor_task"], updates)
+
+        def critic_task_loss_fn(critic_params):
+            values = critic_def.apply(critic_params, aux_t["trajectories"])[:-1]
+            lp = normal_log_prob(values, aux_t["lambda_values"], 1)
+            return -jnp.mean(aux_t["discount"][..., 0] * lp)
+
+        value_loss_task, critic_task_grads = jax.value_and_grad(critic_task_loss_fn)(params["critic_task"])
+        critic_task_grads = pmean_tree(critic_task_grads, axis)
+        updates, opt_states["critic_task"] = optimizers["critic_task"].update(
+            critic_task_grads, opt_states["critic_task"], params["critic_task"]
+        )
+        params["critic_task"] = optax.apply_updates(params["critic_task"], updates)
+
+        metrics = jnp.stack(
+            [
+                rec_loss,
+                aux["observation_loss"],
+                aux["reward_loss"],
+                aux["state_loss"],
+                aux["continue_loss"],
+                aux["kl"],
+                ens_loss,
+                policy_loss_expl,
+                value_loss_expl,
+                policy_loss_task,
+                value_loss_task,
+                aux_e["intrinsic_reward"],
+                aux_e["predicted_values"],
+                jnp.mean(aux_e["lambda_values"]),
+                optax.global_norm(wm_grads),
+                optax.global_norm(ens_grads),
+                optax.global_norm(actor_expl_grads),
+                optax.global_norm(critic_expl_grads),
+                optax.global_norm(actor_task_grads),
+                optax.global_norm(critic_task_grads),
+            ]
+        )
+        metrics = pmean_tree(metrics, axis)
+        return params, opt_states, moments_state, metrics
+
+    return dp_jit(
+        train_step,
+        mesh,
+        in_specs=(P(), P(), P(), batch_spec(batch_axis=1), P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        donate_argnums=(0, 1, 2),
+    )
+
+
+def _build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, state):
+    world_model_def, actor_def, critic_def, ensemble_def, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        state["world_model"] if state else None,
+        state["ensembles"] if state else None,
+        state["actor_task"] if state else None,
+        state["critic_task"] if state else None,
+        state["actor_exploration"] if state else None,
+        state["critic_exploration"] if state else None,
+    )
+    _P2E["ensemble_def"] = ensemble_def
+    return world_model_def, actor_def, critic_def, params
+
+
+def _make_optimizers(cfg, params, agent_state):
+    chain = lambda clip, opt_cfg: optax.chain(  # noqa: E731
+        optax.clip_by_global_norm(clip), instantiate(opt_cfg)
+    )
+    optimizers = {
+        "world_model": chain(cfg.algo.world_model.clip_gradients, cfg.algo.world_model.optimizer),
+        "actor_task": chain(cfg.algo.actor.clip_gradients, cfg.algo.actor.optimizer),
+        "critic_task": chain(cfg.algo.critic.clip_gradients, cfg.algo.critic.optimizer),
+        "actor_exploration": chain(cfg.algo.actor.clip_gradients, cfg.algo.actor.optimizer),
+        "critic_exploration": chain(cfg.algo.critic.clip_gradients, cfg.algo.critic.optimizer),
+        "ensembles": chain(cfg.algo.ensembles.clip_gradients, cfg.algo.ensembles.optimizer),
+    }
+    opt_states = {k: opt.init(params[k]) for k, opt in optimizers.items()}
+    if agent_state and "opt_states" in agent_state:
+        opt_states = jax.tree_util.tree_map(
+            lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
+            opt_states,
+            agent_state["opt_states"],
+        )
+    return optimizers, opt_states
+
+
+def _player_actor(cfg):
+    actor_type = cfg.algo.player.actor_type
+
+    def fn(params, has_trained):
+        return params["actor_exploration"] if actor_type == "exploration" else params["actor_task"]
+
+    return fn
+
+
+def _zero_shot_test(player, params, runtime, cfg, log_dir):
+    return test(
+        player, params["world_model"], params["actor_task"], runtime, cfg, log_dir, "zero-shot", greedy=False
+    )
+
+
+@register_algorithm()
+def main(runtime, cfg):
+    cfg.algo.player.actor_type = "exploration"
+    return _dreamer_main(
+        runtime,
+        cfg,
+        _build_agent,
+        make_train_step,
+        make_optimizers_fn=_make_optimizers,
+        init_moments_fn=lambda cfg, agent_state: {},
+        player_actor_fn=_player_actor(cfg),
+        metric_order=METRIC_ORDER,
+        final_test_fn=_zero_shot_test,
+        player_cls=PlayerDV1,
+    )

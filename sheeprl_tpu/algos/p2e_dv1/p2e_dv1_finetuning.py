@@ -1,0 +1,118 @@
+"""Plan2Explore-DV1 finetuning (reference
+/root/reference/sheeprl/algos/p2e_dv1/p2e_dv1_finetuning.py:27-441): loads an
+exploration checkpoint, continues with the standard DreamerV1 train step;
+player switches exploration -> task actor at the first gradient step."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v1.agent import PlayerDV1
+from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import METRIC_ORDER, make_train_step as dv1_make_train_step
+from sheeprl_tpu.algos.dreamer_v1.utils import AGGREGATOR_KEYS  # noqa: F401
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _dreamer_main
+from sheeprl_tpu.algos.p2e_dv1.agent import build_agent
+from sheeprl_tpu.algos.p2e_dv3.p2e_dv3_finetuning import (
+    apply_exploration_cfg,
+    load_exploration_cfg,
+)
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.utils.registry import register_algorithm
+
+import optax
+
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+
+def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, is_continuous, mesh=None):
+    """Adapt the DV1 step (no Moments, no tau, no actions_dim args) to the
+    engine's ``(params, opt_states, moments, batch, key, tau)`` signature."""
+    dv1_step = dv1_make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, mesh=mesh)
+
+    def step(params, opt_states, moments_state, batch, key, tau):
+        del tau
+        params, opt_states, metrics = dv1_step(params, opt_states, batch, key)
+        return params, opt_states, moments_state, metrics
+
+    return step
+
+
+def _build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, state):
+    is_finetune_ckpt = state is not None and "actor" in state
+    world_model_def, actor_def, critic_def, _, p2e_params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        state["world_model"] if state else None,
+        None,
+        (state["actor"] if is_finetune_ckpt else state["actor_task"]) if state else None,
+        (state["critic"] if is_finetune_ckpt else state["critic_task"]) if state else None,
+        state["actor_exploration"] if state else None,
+        None,
+    )
+    params = {
+        "world_model": p2e_params["world_model"],
+        "actor": p2e_params["actor_task"],
+        "critic": p2e_params["critic_task"],
+        "actor_exploration": p2e_params["actor_exploration"],
+    }
+    return world_model_def, actor_def, critic_def, params
+
+
+def _make_optimizers(cfg, params, agent_state):
+    """DV1 trio (no target critic) with restore from exploration task
+    optimizers."""
+    chain = lambda clip, opt_cfg: optax.chain(  # noqa: E731
+        optax.clip_by_global_norm(clip), instantiate(opt_cfg)
+    )
+    optimizers = {
+        "world_model": chain(cfg.algo.world_model.clip_gradients, cfg.algo.world_model.optimizer),
+        "actor": chain(cfg.algo.actor.clip_gradients, cfg.algo.actor.optimizer),
+        "critic": chain(cfg.algo.critic.clip_gradients, cfg.algo.critic.optimizer),
+    }
+    opt_states = {k: opt.init(params[k]) for k, opt in optimizers.items()}
+    if agent_state and "opt_states" in agent_state:
+        saved = agent_state["opt_states"]
+        mapped = {
+            "world_model": saved["world_model"],
+            "actor": saved["actor_task"] if "actor_task" in saved else saved["actor"],
+            "critic": saved["critic_task"] if "critic_task" in saved else saved["critic"],
+        }
+        opt_states = jax.tree_util.tree_map(
+            lambda ref, s: jnp.asarray(s, dtype=getattr(ref, "dtype", None)), opt_states, mapped
+        )
+    return optimizers, opt_states
+
+
+def _player_actor(cfg):
+    def fn(params, has_trained):
+        if has_trained or cfg.algo.player.actor_type == "task":
+            return params["actor"]
+        return params["actor_exploration"]
+
+    return fn
+
+
+@register_algorithm()
+def main(runtime, cfg):
+    exploration_cfg = load_exploration_cfg(cfg)
+    apply_exploration_cfg(cfg, exploration_cfg)
+
+    def load_agent_state_fn(runtime, cfg):
+        return runtime.load(cfg.checkpoint.exploration_ckpt_path)
+
+    return _dreamer_main(
+        runtime,
+        cfg,
+        _build_agent,
+        make_train_step,
+        make_optimizers_fn=_make_optimizers,
+        init_moments_fn=lambda cfg, agent_state: {},
+        player_actor_fn=_player_actor(cfg),
+        metric_order=METRIC_ORDER,
+        load_agent_state_fn=load_agent_state_fn,
+        player_cls=PlayerDV1,
+    )

@@ -1,0 +1,92 @@
+"""Plan2Explore-DV2 agent (reference /root/reference/sheeprl/algos/p2e_dv2/agent.py:30-209).
+
+DreamerV2 stack + exploration actor, a single exploration critic with target
+copy, and a vmapped ensemble predicting the next stochastic state from
+``(posterior, recurrent, action)`` (reference agent.py:120-165)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v2.agent import build_agent as dv2_build_agent
+from sheeprl_tpu.algos.p2e_dv3.agent import Ensemble
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    ensembles_state: Optional[Dict[str, Any]] = None,
+    actor_task_state: Optional[Dict[str, Any]] = None,
+    critic_task_state: Optional[Dict[str, Any]] = None,
+    target_critic_task_state: Optional[Dict[str, Any]] = None,
+    actor_exploration_state: Optional[Dict[str, Any]] = None,
+    critic_exploration_state: Optional[Dict[str, Any]] = None,
+    target_critic_exploration_state: Optional[Dict[str, Any]] = None,
+):
+    """Returns ``(world_model_def, actor_def, critic_def, ensemble_def,
+    params)`` with params keys: world_model, actor_task, critic_task,
+    target_critic_task, actor_exploration, critic_exploration,
+    target_critic_exploration, ensembles."""
+    world_model_def, actor_def, critic_def, dv2_params = dv2_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+        target_critic_task_state,
+    )
+    wm_cfg = cfg.algo.world_model
+    stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
+    latent_state_size = stoch_flat + wm_cfg.recurrent_model.recurrent_state_size
+
+    key = jax.random.PRNGKey(int(cfg.seed or 0) + 29)
+    k_actor, k_critic, k_ens = jax.random.split(key, 3)
+    sample_latent = jnp.zeros((1, latent_state_size), jnp.float32)
+
+    actor_exploration_params = actor_def.init(k_actor, sample_latent)
+    if actor_exploration_state is not None:
+        actor_exploration_params = jax.tree_util.tree_map(jnp.asarray, actor_exploration_state)
+    critic_exploration_params = critic_def.init(k_critic, sample_latent)
+    if critic_exploration_state is not None:
+        critic_exploration_params = jax.tree_util.tree_map(jnp.asarray, critic_exploration_state)
+    target_critic_exploration_params = jax.tree_util.tree_map(jnp.copy, critic_exploration_params)
+    if target_critic_exploration_state is not None:
+        target_critic_exploration_params = jax.tree_util.tree_map(
+            jnp.asarray, target_critic_exploration_state
+        )
+
+    ens_cfg = cfg.algo.ensembles
+    ensemble_def = Ensemble(
+        output_dim=stoch_flat,
+        dense_units=ens_cfg.dense_units,
+        mlp_layers=ens_cfg.mlp_layers,
+        layer_norm=bool(cfg.algo.get("layer_norm", False)),
+        hafner_initialization=False,
+    )
+    sample_in = jnp.zeros((1, latent_state_size + int(sum(actions_dim))), jnp.float32)
+    member_keys = jax.random.split(k_ens, int(ens_cfg.n))
+    ensembles_params = jax.vmap(lambda k: ensemble_def.init(k, sample_in))(member_keys)
+    if ensembles_state is not None:
+        ensembles_params = jax.tree_util.tree_map(jnp.asarray, ensembles_state)
+
+    params = {
+        "world_model": dv2_params["world_model"],
+        "actor_task": dv2_params["actor"],
+        "critic_task": dv2_params["critic"],
+        "target_critic_task": dv2_params["target_critic"],
+        "actor_exploration": actor_exploration_params,
+        "critic_exploration": critic_exploration_params,
+        "target_critic_exploration": target_critic_exploration_params,
+        "ensembles": ensembles_params,
+    }
+    return world_model_def, actor_def, critic_def, ensemble_def, params

@@ -1,0 +1,147 @@
+"""Plan2Explore-DV3 agent (reference /root/reference/sheeprl/algos/p2e_dv3/agent.py:27-223).
+
+On top of the DreamerV3 stack this adds:
+
+- an **exploration actor** (same ``Actor`` module, its own params);
+- a dict of **exploration critics** keyed by name, each with a weight, a
+  reward type (``intrinsic`` | ``task``), its own params and a target copy
+  (reference agent.py:119-156);
+- an **ensemble** of N MLPs predicting the next stochastic state from
+  ``(posterior, recurrent, action)`` whose disagreement (variance) is the
+  intrinsic reward (reference agent.py:174-204).
+
+TPU-native design note: the reference keeps the N ensembles as an
+``nn.ModuleList`` looped in Python; here the N parameter sets are **stacked
+on a leading axis and applied with ``jax.vmap``** — one fused XLA computation
+for all members, which is how an ensemble should meet the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    Critic,
+    DenseStack,
+    build_agent as dv3_build_agent,
+    trunc_normal_init,
+    uniform_init,
+)
+
+
+class Ensemble(nn.Module):
+    """One ensemble member: MLP ``(latent, action) -> next stochastic state``
+    (reference agent.py:181-199 builds N of these)."""
+
+    output_dim: int
+    dense_units: int
+    mlp_layers: int
+    eps: float = 1e-3
+    act: str = "silu"
+    layer_norm: bool = True
+    hafner_initialization: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = DenseStack(self.dense_units, self.mlp_layers, self.eps, self.act, self.layer_norm)(x)
+        init = uniform_init(0.0) if self.hafner_initialization else trunc_normal_init
+        return nn.Dense(self.output_dim, kernel_init=init)(x)
+
+
+def exploration_critics_spec(cfg) -> List[Tuple[str, float, str]]:
+    """Sorted ``(name, weight, reward_type)`` for every critic with weight>0
+    (reference agent.py:121-141).  At least one must be intrinsic."""
+    spec = []
+    for k in sorted(cfg.algo.critics_exploration):
+        v = cfg.algo.critics_exploration[k]
+        if v.weight > 0:
+            spec.append((k, float(v.weight), str(v.reward_type)))
+    if not any(rt == "intrinsic" for _, _, rt in spec):
+        raise RuntimeError("You must specify at least one intrinsic critic (`reward_type='intrinsic'`)")
+    return spec
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg,
+    obs_space,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    ensembles_state: Optional[Dict[str, Any]] = None,
+    actor_task_state: Optional[Dict[str, Any]] = None,
+    critic_task_state: Optional[Dict[str, Any]] = None,
+    target_critic_task_state: Optional[Dict[str, Any]] = None,
+    actor_exploration_state: Optional[Dict[str, Any]] = None,
+    critics_exploration_state: Optional[Dict[str, Any]] = None,
+):
+    """Returns ``(world_model_def, actor_def, critic_def, ensemble_def,
+    params, critics_spec)``.
+
+    ``params`` keys: world_model, actor_task, critic_task, target_critic_task,
+    actor_exploration, critics_exploration ({k: {module, target_module}}),
+    ensembles (leading-axis-stacked member params).
+    """
+    world_model_def, actor_def, critic_def, dv3_params = dv3_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+        target_critic_task_state,
+    )
+    wm_cfg = cfg.algo.world_model
+    stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
+    latent_state_size = stoch_flat + wm_cfg.recurrent_model.recurrent_state_size
+    eps = float(cfg.algo.mlp_layer_norm.kw.get("eps", 1e-3)) if cfg.algo.get("mlp_layer_norm") else 1e-3
+
+    key = jax.random.PRNGKey(int(cfg.seed or 0) + 17)
+    k_actor_expl, k_crit, k_ens = jax.random.split(key, 3)
+    sample_latent = jnp.zeros((1, latent_state_size), jnp.float32)
+
+    # exploration actor: same definition, freshly initialized params
+    actor_exploration_params = actor_def.init(k_actor_expl, sample_latent)
+    if actor_exploration_state is not None:
+        actor_exploration_params = jax.tree_util.tree_map(jnp.asarray, actor_exploration_state)
+
+    # exploration critics (same Critic module as the task critic)
+    critics_spec = exploration_critics_spec(cfg)
+    critics_params: Dict[str, Dict[str, Any]] = {}
+    for i, (name, _, _) in enumerate(critics_spec):
+        cp = critic_def.init(jax.random.fold_in(k_crit, i), sample_latent)
+        critics_params[name] = {"module": cp, "target_module": jax.tree_util.tree_map(jnp.copy, cp)}
+    if critics_exploration_state is not None:
+        critics_params = jax.tree_util.tree_map(jnp.asarray, dict(critics_exploration_state))
+
+    # vmapped ensemble: stack N independent inits on a leading axis
+    ens_cfg = cfg.algo.ensembles
+    ensemble_def = Ensemble(
+        output_dim=stoch_flat,
+        dense_units=ens_cfg.dense_units,
+        mlp_layers=ens_cfg.mlp_layers,
+        eps=eps,
+        hafner_initialization=cfg.algo.hafner_initialization,
+    )
+    sample_in = jnp.zeros((1, latent_state_size + int(sum(actions_dim))), jnp.float32)
+    member_keys = jax.random.split(k_ens, int(ens_cfg.n))
+    ensembles_params = jax.vmap(lambda k: ensemble_def.init(k, sample_in))(member_keys)
+    if ensembles_state is not None:
+        ensembles_params = jax.tree_util.tree_map(jnp.asarray, ensembles_state)
+
+    params = {
+        "world_model": dv3_params["world_model"],
+        "actor_task": dv3_params["actor"],
+        "critic_task": dv3_params["critic"],
+        "target_critic_task": dv3_params["target_critic"],
+        "actor_exploration": actor_exploration_params,
+        "critics_exploration": critics_params,
+        "ensembles": ensembles_params,
+    }
+    return world_model_def, actor_def, critic_def, ensemble_def, params, critics_spec

@@ -1,0 +1,186 @@
+"""Plan2Explore-DV3 finetuning (reference
+/root/reference/sheeprl/algos/p2e_dv3/p2e_dv3_finetuning.py:28-477).
+
+Bootstraps from an **exploration checkpoint**
+(``checkpoint.exploration_ckpt_path``): world model, task actor/critic (and
+their optimizer states + task Moments) come from the exploration phase; the
+training loop itself is standard DreamerV3 (the reference literally imports
+``dreamer_v3.train``).  The player acts with the *exploration* actor during
+prefill and switches to the *task* actor at the first gradient step
+(reference :350-354).
+
+Config surgery: the model/topology fields must match the exploration run, so
+they are copied from the exploration run's archived ``config.yaml``
+(reference cli.py:117-148 does this in the CLI; here it lives in the
+algorithm main so the CLI stays generic).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import yaml
+
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+    METRIC_ORDER,
+    _default_make_optimizers,
+    _dreamer_main,
+    make_train_step,
+)
+from sheeprl_tpu.algos.dreamer_v3.utils import AGGREGATOR_KEYS, init_moments_state  # noqa: F401
+from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.utils import dotdict
+
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}
+
+
+def load_exploration_cfg(cfg) -> dotdict:
+    ckpt_path = pathlib.Path(cfg.checkpoint.exploration_ckpt_path)
+    cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not cfg_path.is_file():
+        raise FileNotFoundError(
+            f"Archived exploration config not found at '{cfg_path}' "
+            "(checkpoint.exploration_ckpt_path must point inside an exploration run dir)"
+        )
+    with open(cfg_path) as fp:
+        return dotdict(yaml.safe_load(fp))
+
+
+def apply_exploration_cfg(cfg, exploration_cfg) -> None:
+    """Copy the model/topology/env fields that must match the exploration run
+    (reference cli.py:117-148 + p2e_dv3_finetuning.py:45-71)."""
+    if exploration_cfg.env.id != cfg.env.id:
+        raise ValueError(
+            "Finetuning must use the exploration environment: "
+            f"got '{cfg.env.id}', exploration used '{exploration_cfg.env.id}'"
+        )
+    for k in (
+        "gamma",
+        "lmbda",
+        "horizon",
+        "layer_norm",
+        "dense_units",
+        "mlp_layers",
+        "dense_act",
+        "cnn_act",
+        "unimix",
+        "hafner_initialization",
+        "world_model",
+        "actor",
+        "critic",
+        "cnn_keys",
+        "mlp_keys",
+    ):
+        if k in exploration_cfg.algo:
+            cfg.algo[k] = exploration_cfg.algo[k]
+    for k in (
+        "screen_size",
+        "action_repeat",
+        "grayscale",
+        "clip_rewards",
+        "frame_stack_dilation",
+        "max_episode_steps",
+        "reward_as_observation",
+    ):
+        if k in exploration_cfg.env:
+            cfg.env[k] = exploration_cfg.env[k]
+    if cfg.buffer.get("load_from_exploration") and exploration_cfg.buffer.checkpoint:
+        cfg.env.num_envs = exploration_cfg.env.num_envs
+
+
+def _build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, state):
+    """Build the DV3-layout agent from a P2E state (exploration checkpoint,
+    or a finetuning checkpoint when resuming — the latter stores DV3-style
+    keys plus ``actor_exploration``)."""
+    is_finetune_ckpt = state is not None and "actor" in state
+    wm_state = state["world_model"] if state else None
+    actor_task_state = (state["actor"] if is_finetune_ckpt else state["actor_task"]) if state else None
+    critic_task_state = (state["critic"] if is_finetune_ckpt else state["critic_task"]) if state else None
+    target_state = (
+        (state["target_critic"] if is_finetune_ckpt else state["target_critic_task"]) if state else None
+    )
+    world_model_def, actor_def, critic_def, _, p2e_params, _ = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        wm_state,
+        None,
+        actor_task_state,
+        critic_task_state,
+        target_state,
+        state["actor_exploration"] if state else None,
+        None,
+    )
+    params = {
+        "world_model": p2e_params["world_model"],
+        "actor": p2e_params["actor_task"],
+        "critic": p2e_params["critic_task"],
+        "target_critic": p2e_params["target_critic_task"],
+        "actor_exploration": p2e_params["actor_exploration"],
+    }
+    return world_model_def, actor_def, critic_def, params
+
+
+def _make_optimizers(cfg, params, agent_state):
+    """DV3 trio; restore from the exploration checkpoint's task-optimizer
+    states (keys ``actor_task``/``critic_task``) or a finetuning resume
+    checkpoint (DV3 keys)."""
+    optimizers, opt_states = _default_make_optimizers(cfg, params, None)
+    if agent_state and "opt_states" in agent_state:
+        saved = agent_state["opt_states"]
+        mapped = {
+            "world_model": saved["world_model"],
+            "actor": saved["actor_task"] if "actor_task" in saved else saved["actor"],
+            "critic": saved["critic_task"] if "critic_task" in saved else saved["critic"],
+        }
+        opt_states = jax.tree_util.tree_map(
+            lambda ref, s: jnp.asarray(s, dtype=getattr(ref, "dtype", None)), opt_states, mapped
+        )
+    return optimizers, opt_states
+
+
+def _init_moments(cfg, agent_state):
+    moments = init_moments_state()
+    if agent_state and "moments" in agent_state:
+        saved = agent_state["moments"]
+        if isinstance(saved, dict) and "task" in saved:  # exploration ckpt layout
+            saved = saved["task"]
+        moments = jax.tree_util.tree_map(jnp.asarray, saved)
+    return moments
+
+
+def _player_actor(cfg):
+    def fn(params, has_trained):
+        # prefill with the exploration actor, then switch to the task actor
+        # at the first gradient step (reference :350-354)
+        if has_trained or cfg.algo.player.actor_type == "task":
+            return params["actor"]
+        return params["actor_exploration"]
+
+    return fn
+
+
+@register_algorithm()
+def main(runtime, cfg):
+    exploration_cfg = load_exploration_cfg(cfg)
+    apply_exploration_cfg(cfg, exploration_cfg)
+
+    def load_agent_state_fn(runtime, cfg):
+        return runtime.load(cfg.checkpoint.exploration_ckpt_path)
+
+    return _dreamer_main(
+        runtime,
+        cfg,
+        _build_agent,
+        make_train_step,
+        make_optimizers_fn=_make_optimizers,
+        init_moments_fn=_init_moments,
+        player_actor_fn=_player_actor(cfg),
+        metric_order=METRIC_ORDER,
+        load_agent_state_fn=load_agent_state_fn,
+    )

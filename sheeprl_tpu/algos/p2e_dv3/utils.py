@@ -1,0 +1,67 @@
+"""P2E-DV3 helpers (reference /root/reference/sheeprl/algos/p2e_dv3/utils.py)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v3.utils import AGGREGATOR_KEYS as AGGREGATOR_KEYS_DV3
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Loss/ensemble_loss",
+    "Loss/policy_loss_exploration",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Grads/world_model",
+    "Grads/ensemble",
+    "Grads/actor_exploration",
+    "Grads/actor_task",
+    "Grads/critic_task",
+    # generic per-exploration-critic keys; the exploration main expands them
+    # to `<key>_<critic_name>` (reference p2e_dv3_exploration.py:683-706)
+    "Loss/value_loss_exploration",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Grads/critic_exploration",
+    "Rewards/intrinsic",
+}.union(AGGREGATOR_KEYS_DV3)
+
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+    "critics_exploration",
+    "moments_task",
+    "moments_exploration",
+}
+
+GENERIC_CRITIC_METRICS = (
+    "Loss/value_loss_exploration",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Grads/critic_exploration",
+    "Rewards/intrinsic",
+)
+
+
+def expand_exploration_metric_keys(cfg, critic_names) -> None:
+    """Replace the generic exploration-critic metric configs with one entry
+    per critic (reference p2e_dv3_exploration.py:683-706)."""
+    metrics = cfg.metric.aggregator.get("metrics", {})
+    for generic in GENERIC_CRITIC_METRICS:
+        template = metrics.pop(generic, None)
+        if template is None:
+            continue
+        for name in critic_names:
+            metrics[f"{generic}_{name}"] = template
+    cfg.metric.aggregator.metrics = metrics
