@@ -1,0 +1,102 @@
+"""Shared host-side logic for the Minecraft-family adapters (MineRL, MineDojo).
+
+The reference duplicates sticky-action bookkeeping and pitch clamping in both
+`/root/reference/sheeprl/envs/minerl.py:238-252,293-306` and
+`/root/reference/sheeprl/envs/minedojo.py:184-224,243-248`.  Here that state
+machine lives once, as a pure dataclass with no simulator dependency, so it is
+unit-testable in this image (neither `minerl` nor `minedojo` is installed) and
+both adapters stay thin.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+import numpy as np
+
+__all__ = ["StickyActions", "PitchTracker", "count_items"]
+
+
+@dataclass
+class StickyActions:
+    """Repeat `attack`/`jump` for a configurable number of steps after they
+    were last selected (Hafner's Minecraft trick; reference minerl.py:238-252).
+
+    `attack_for`/`jump_for` of 0 disables the respective stickiness.  The
+    caller asks `update(attack=..., jump=...)` each step with the *selected*
+    flags and receives the *effective* flags.
+    """
+
+    attack_for: int = 30
+    jump_for: int = 10
+    _attack_left: int = field(default=0, init=False)
+    _jump_left: int = field(default=0, init=False)
+
+    def update(self, attack: bool, jump: bool, cancel_attack: bool = False) -> Tuple[bool, bool]:
+        """`cancel_attack=True` means the agent picked a *different* functional
+        action this step, which interrupts a pending sticky attack (MineDojo
+        semantics, reference minedojo.py:196-198)."""
+        if self.attack_for:
+            if attack:
+                self._attack_left = self.attack_for
+            elif cancel_attack:
+                self._attack_left = 0
+            if self._attack_left > 0:
+                attack = True
+                jump = False
+                self._attack_left -= 1
+        if self.jump_for:
+            if jump:
+                self._jump_left = self.jump_for
+            if self._jump_left > 0:
+                jump = True
+                self._jump_left -= 1
+        return attack, jump
+
+    def reset(self) -> None:
+        self._attack_left = 0
+        self._jump_left = 0
+
+
+@dataclass
+class PitchTracker:
+    """Track camera pitch/yaw and veto camera commands that would push the
+    pitch outside `limits` (reference minerl.py:293-299, minedojo.py:243-248).
+    """
+
+    limits: Tuple[float, float] = (-60.0, 60.0)
+    pitch: float = field(default=0.0, init=False)
+    yaw: float = field(default=0.0, init=False)
+
+    def apply(self, d_pitch: float, d_yaw: float) -> Tuple[float, float]:
+        """Returns the (possibly vetoed) camera delta actually allowed."""
+        new_pitch = self.pitch + d_pitch
+        if not (self.limits[0] <= new_pitch <= self.limits[1]):
+            d_pitch = 0.0
+            new_pitch = self.pitch
+        self.pitch = new_pitch
+        self.yaw = ((self.yaw + d_yaw) + 180.0) % 360.0 - 180.0
+        return d_pitch, d_yaw
+
+    def reset(self, pitch: float = 0.0, yaw: float = 0.0) -> None:
+        self.pitch = pitch
+        self.yaw = yaw
+
+
+def count_items(
+    names, quantities, name_to_id: Dict[str, int], size: int, air_counts_once: bool = True
+) -> np.ndarray:
+    """Turn an (item name, quantity) listing into a dense per-item count vector
+    (the multihot inventory of reference minerl.py:262-273 / minedojo.py:124-144).
+
+    Minecraft reports every empty slot as one `air` item; with
+    `air_counts_once` each air slot contributes 1 (matching the reference).
+    """
+    counts = np.zeros(size, dtype=np.float32)
+    for name, qty in zip(names, quantities):
+        name = "_".join(str(name).split(" "))
+        if name not in name_to_id:
+            continue
+        counts[name_to_id[name]] += 1.0 if (name == "air" and air_counts_once) else float(qty)
+    return counts

@@ -1,0 +1,1 @@
+"""Custom MineRL task specs (reference: /root/reference/sheeprl/envs/minerl_envs/)."""

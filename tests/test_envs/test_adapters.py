@@ -1,0 +1,162 @@
+"""Unit tests for the env-adapter logic that runs without the simulators.
+
+The heavyweight sims (dm_control, crafter, minerl, minedojo, diambra,
+gym-super-mario-bros) are not in the image; the adapters gate on import.
+These tests cover (a) the import gates, and (b) the pure conversion logic
+shared by the Minecraft adapters (`sheeprl_tpu/envs/_minecraft.py`), which the
+reference duplicates inside its wrappers (minerl.py:238-306,
+minedojo.py:184-224).
+"""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs._minecraft import PitchTracker, StickyActions, count_items
+from sheeprl_tpu.utils import imports as gates
+
+
+@pytest.mark.parametrize(
+    ("module", "flag"),
+    [
+        ("sheeprl_tpu.envs.dmc", gates._IS_DMC_AVAILABLE),
+        ("sheeprl_tpu.envs.crafter", gates._IS_CRAFTER_AVAILABLE),
+        ("sheeprl_tpu.envs.diambra", gates._IS_DIAMBRA_AVAILABLE),
+        ("sheeprl_tpu.envs.minedojo", gates._IS_MINEDOJO_AVAILABLE),
+        ("sheeprl_tpu.envs.minerl", gates._IS_MINERL_AVAILABLE),
+        ("sheeprl_tpu.envs.minerl_envs.specs", gates._IS_MINERL_AVAILABLE),
+        ("sheeprl_tpu.envs.super_mario_bros", gates._IS_SUPER_MARIO_AVAILABLE),
+    ],
+)
+def test_adapter_import_gate(module, flag):
+    """Adapters raise ModuleNotFoundError when their sim is missing, and
+    import cleanly when it is present (reference envs/dmc.py:5-6 etc.)."""
+    import importlib
+
+    if flag:
+        importlib.import_module(module)
+    else:
+        with pytest.raises(ModuleNotFoundError):
+            importlib.import_module(module)
+
+
+class TestStickyActions:
+    def test_attack_repeats_for_n_steps(self):
+        sticky = StickyActions(attack_for=3, jump_for=0)
+        assert sticky.update(attack=True, jump=False) == (True, False)
+        # two more sticky repeats with attack not selected
+        assert sticky.update(attack=False, jump=False) == (True, False)
+        assert sticky.update(attack=False, jump=False) == (True, False)
+        assert sticky.update(attack=False, jump=False) == (False, False)
+
+    def test_sticky_attack_suppresses_jump(self):
+        sticky = StickyActions(attack_for=2, jump_for=0)
+        sticky.update(attack=True, jump=False)
+        # while attacking stickily, a jump request is suppressed
+        assert sticky.update(attack=False, jump=True) == (True, False)
+
+    def test_jump_repeats_and_coexists(self):
+        sticky = StickyActions(attack_for=0, jump_for=2)
+        assert sticky.update(attack=False, jump=True) == (False, True)
+        assert sticky.update(attack=False, jump=False) == (False, True)
+        assert sticky.update(attack=False, jump=False) == (False, False)
+
+    def test_cancel_attack(self):
+        """MineDojo semantics: choosing another functional action interrupts
+        a pending sticky attack (reference minedojo.py:196-198)."""
+        sticky = StickyActions(attack_for=5, jump_for=0)
+        sticky.update(attack=True, jump=False)
+        assert sticky.update(attack=False, jump=False, cancel_attack=True) == (False, False)
+        assert sticky.update(attack=False, jump=False) == (False, False)
+
+    def test_disabled(self):
+        sticky = StickyActions(attack_for=0, jump_for=0)
+        assert sticky.update(attack=True, jump=True) == (True, True)
+        assert sticky.update(attack=False, jump=False) == (False, False)
+
+    def test_reset(self):
+        sticky = StickyActions(attack_for=5, jump_for=5)
+        sticky.update(attack=True, jump=True)
+        sticky.reset()
+        assert sticky.update(attack=False, jump=False) == (False, False)
+
+
+class TestPitchTracker:
+    def test_within_limits_tracks(self):
+        pt = PitchTracker(limits=(-60, 60))
+        assert pt.apply(15.0, -15.0) == (15.0, -15.0)
+        assert pt.pitch == 15.0 and pt.yaw == -15.0
+
+    def test_vetoes_out_of_range_pitch(self):
+        pt = PitchTracker(limits=(-60, 60))
+        pt.apply(60.0, 0.0)
+        # next +15 would exceed +60 -> pitch move vetoed, yaw still applies
+        assert pt.apply(15.0, 15.0) == (0.0, 15.0)
+        assert pt.pitch == 60.0 and pt.yaw == 15.0
+
+    def test_yaw_wraps_to_signed_180(self):
+        pt = PitchTracker()
+        pt.apply(0.0, 170.0)
+        pt.apply(0.0, 20.0)
+        assert pt.yaw == -170.0
+
+    def test_reset_to_position(self):
+        pt = PitchTracker()
+        pt.apply(30.0, 30.0)
+        pt.reset(pitch=-10.0, yaw=5.0)
+        assert pt.pitch == -10.0 and pt.yaw == 5.0
+
+
+class TestCountItems:
+    NAME_TO_ID = {"air": 0, "dirt": 1, "iron ingot": 2, "iron_ingot": 2}
+
+    def test_counts_quantities(self):
+        counts = count_items(["dirt", "dirt"], [3, 2], self.NAME_TO_ID, 3)
+        assert counts.tolist() == [0.0, 5.0, 0.0]
+
+    def test_air_counts_once_per_slot(self):
+        counts = count_items(["air", "air"], [64, 64], self.NAME_TO_ID, 3)
+        assert counts[0] == 2.0
+
+    def test_spaces_normalized_to_underscores(self):
+        counts = count_items(["iron ingot"], [4], self.NAME_TO_ID, 3)
+        assert counts[2] == 4.0
+
+    def test_unknown_items_ignored(self):
+        counts = count_items(["unobtainium"], [9], self.NAME_TO_ID, 3)
+        assert counts.sum() == 0.0
+
+    def test_dtype_and_shape(self):
+        counts = count_items([], [], self.NAME_TO_ID, 3)
+        assert counts.dtype == np.float32 and counts.shape == (3,)
+
+
+@pytest.mark.skipif(not gates._IS_DMC_AVAILABLE, reason="dm_control not installed")
+def test_dmc_wrapper_vectors_roundtrip():
+    """Real dm_control episode slice: normalized actions in, Dict obs out,
+    no termination mid-episode (reference dmc.py:217-241).  Pixels need a GL
+    backend the image lacks, so vectors only."""
+    from sheeprl_tpu.envs.dmc import DMCWrapper
+
+    env = DMCWrapper("cartpole", "balance", from_pixels=False, from_vectors=True, seed=3)
+    obs, _ = env.reset(seed=3)
+    assert set(obs) == {"state"} and obs["state"].shape == env.observation_space["state"].shape
+    assert env.action_space.low.tolist() == [-1.0] and env.action_space.high.tolist() == [1.0]
+    for _ in range(5):
+        obs, reward, terminated, truncated, info = env.step(env.action_space.sample())
+        assert np.isfinite(reward) and not terminated and not truncated
+        assert "discount" in info and "internal_state" in info
+    env.close()
+
+
+def test_new_env_configs_compose():
+    """Every new env config composes against a pixel algo config
+    (reference has 14 env yamls; VERDICT row 3)."""
+    from sheeprl_tpu.config import compose
+
+    for env in ["dmc", "crafter", "diambra", "minedojo", "minerl",
+                "minerl_obtain_diamond", "minerl_obtain_iron_pickaxe",
+                "super_mario_bros", "mujoco"]:
+        cfg = compose(["exp=dreamer_v3", f"env={env}"])
+        assert cfg.env is not None
+        if env != "mujoco":  # mujoco rides the generic gym wrapper
+            assert "_target_" in cfg.env.wrapper
