@@ -28,6 +28,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.ops.numerics import gae
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -39,9 +40,13 @@ def make_train_step(agent, optimizer, cfg, mesh):
     """One whole-batch gradient step, data-parallel over the mesh."""
     world = mesh.devices.size
     distributed = world > 1
+    cdt = compute_dtype_of(cfg)
 
     def loss_fn(params, batch):
-        _, logprobs, _, values = agent.apply(params, batch["obs"], actions=batch["actions"])
+        _, logprobs, _, values = agent.apply(
+            cast_floating(params, cdt), cast_floating(batch["obs"], cdt), actions=batch["actions"]
+        )
+        values = values.astype(jnp.float32)
         advantages = batch["advantages"]
         if cfg.algo.get("normalize_advantages", False):
             mu, std = advantages.mean(), advantages.std()
@@ -121,6 +126,7 @@ def main(runtime, cfg):
     agent, params, _ = build_agent(
         runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
     )
+    params = cast_floating(params, runtime.param_dtype)
     base_opt = instantiate(cfg.algo.optimizer)
     chain = []
     if cfg.algo.max_grad_norm and cfg.algo.max_grad_norm > 0:

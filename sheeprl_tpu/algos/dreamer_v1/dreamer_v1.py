@@ -35,6 +35,7 @@ from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import Bernoulli
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -58,6 +59,7 @@ METRIC_ORDER = [
 
 def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, mesh=None):
     axis = dp_axis(mesh)
+    cdt = compute_dtype_of(cfg)
     wm_cfg = cfg.algo.world_model
     stochastic_size = wm_cfg.stochastic_size
     recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
@@ -71,9 +73,12 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, mes
         T, B = batch["actions"].shape[:2]
         key = fold_key(key, axis)
         k_wm, k_img = jax.random.split(key)
-        batch_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}
+        target_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}  # fp32 targets
+        batch_obs = cast_floating(target_obs, cdt)
+        batch_actions = cast_floating(batch["actions"], cdt)
 
         def wm_loss_fn(wm_params):
+            wm_params = cast_floating(wm_params, cdt)
             embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
 
             def scan_body(carry, x):
@@ -85,9 +90,9 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, mes
                 return (posterior, recurrent), (recurrent, posterior, post_ms, prior_ms)
 
             keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stochastic_size)), jnp.zeros((B, recurrent_size)))
+            init = (jnp.zeros((B, stochastic_size), cdt), jnp.zeros((B, recurrent_size), cdt))
             _, (recurrents, posteriors, post_ms, prior_ms) = jax.lax.scan(
-                scan_body, init, (batch["actions"], embedded, keys_t)
+                scan_body, init, (batch_actions, embedded, keys_t)
             )
             latents = jnp.concatenate([posteriors, recurrents], axis=-1)
             recon = world_model_def.apply(wm_params, latents, method="decode")
@@ -101,7 +106,7 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, mes
                 qc = continues_targets = None
             rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
                 recon,
-                batch_obs,
+                target_obs,
                 reward_mean,
                 batch["rewards"],
                 post_ms,
@@ -130,11 +135,12 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, mes
         )
         params["world_model"] = optax.apply_updates(params["world_model"], updates)
 
-        wm_params = params["world_model"]
+        wm_params = cast_floating(params["world_model"], cdt)
         posteriors = jax.lax.stop_gradient(aux["posteriors"]).reshape(T * B, stochastic_size)
         recurrents = jax.lax.stop_gradient(aux["recurrents"]).reshape(T * B, recurrent_size)
 
         def actor_loss_fn(actor_params):
+            actor_params = cast_floating(actor_params, cdt)
             latent0 = jnp.concatenate([posteriors, recurrents], axis=-1)
 
             def img_body(carry, key_t):
@@ -153,12 +159,16 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, mes
             _, latents_h = jax.lax.scan(img_body, (posteriors, recurrents, latent0), keys_h)
             imagined_trajectories = latents_h  # [H, TB, L] (reference keeps H states)
 
-            predicted_values = critic_def.apply(params["critic"], imagined_trajectories)
-            predicted_rewards = world_model_def.apply(wm_params, imagined_trajectories, method="reward_logits")
+            predicted_values = critic_def.apply(
+                cast_floating(params["critic"], cdt), imagined_trajectories
+            ).astype(jnp.float32)
+            predicted_rewards = world_model_def.apply(
+                wm_params, imagined_trajectories, method="reward_logits"
+            ).astype(jnp.float32)
             if use_continues:
                 predicted_continues = jax.nn.sigmoid(
                     world_model_def.apply(wm_params, imagined_trajectories, method="continue_logits")
-                )
+                ).astype(jnp.float32)
             else:
                 predicted_continues = jnp.ones_like(jax.lax.stop_gradient(predicted_rewards)) * gamma
 
@@ -197,7 +207,7 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, mes
         discount = aux2["discount"]
 
         def critic_loss_fn(critic_params):
-            values = critic_def.apply(critic_params, imagined_trajectories)[:-1]
+            values = critic_def.apply(cast_floating(critic_params, cdt), imagined_trajectories)[:-1]
             lp = normal_log_prob(values, lambda_values, 1)
             return -jnp.mean(discount[..., 0] * lp)
 

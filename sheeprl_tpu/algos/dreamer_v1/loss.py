@@ -12,7 +12,9 @@ from sheeprl_tpu.ops.distributions import Bernoulli
 
 
 def kl_normal(p_mean, p_std, q_mean, q_std, event_dims: int = 1) -> jax.Array:
-    """KL(N(p) || N(q)) summed over the stochastic axis."""
+    """KL(N(p) || N(q)) summed over the stochastic axis (fp32)."""
+    p_mean, p_std = p_mean.astype(jnp.float32), p_std.astype(jnp.float32)
+    q_mean, q_std = q_mean.astype(jnp.float32), q_std.astype(jnp.float32)
     var_ratio = (p_std / q_std) ** 2
     t1 = ((p_mean - q_mean) / q_std) ** 2
     kl = 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))

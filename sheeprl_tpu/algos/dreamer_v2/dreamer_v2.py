@@ -36,6 +36,7 @@ from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import Bernoulli
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -59,6 +60,7 @@ METRIC_ORDER = [
 
 def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, is_continuous, mesh=None):
     axis = dp_axis(mesh)
+    cdt = compute_dtype_of(cfg)
     wm_cfg = cfg.algo.world_model
     stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
     recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
@@ -79,10 +81,13 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
             lambda c, t: tau * c + (1 - tau) * t, params["critic"], params["target_critic"]
         )
 
-        batch_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}
-        is_first = batch["is_first"].at[0].set(1.0)
+        target_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}  # fp32 targets
+        batch_obs = cast_floating(target_obs, cdt)
+        batch_actions = cast_floating(batch["actions"], cdt)
+        is_first = batch["is_first"].at[0].set(1.0).astype(cdt)
 
         def wm_loss_fn(wm_params):
+            wm_params = cast_floating(wm_params, cdt)
             embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
 
             def scan_body(carry, x):
@@ -94,9 +99,9 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
                 return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
             keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stoch_flat)), jnp.zeros((B, recurrent_size)))
+            init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
             _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
-                scan_body, init, (batch["actions"], embedded, is_first, keys_t)
+                scan_body, init, (batch_actions, embedded, is_first, keys_t)
             )
             latents = jnp.concatenate([posteriors, recurrents], axis=-1)
             recon = world_model_def.apply(wm_params, latents, method="decode")
@@ -112,7 +117,7 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
             ql = post_logits.reshape(T, B, wm_cfg.stochastic_size, wm_cfg.discrete_size)
             rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
                 recon,
-                batch_obs,
+                target_obs,
                 reward_mean,
                 batch["rewards"],
                 pl,
@@ -143,12 +148,13 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
         )
         params["world_model"] = optax.apply_updates(params["world_model"], updates)
 
-        wm_params = params["world_model"]
+        wm_params = cast_floating(params["world_model"], cdt)
         posteriors = jax.lax.stop_gradient(aux["posteriors"]).reshape(T * B, stoch_flat)
         recurrents = jax.lax.stop_gradient(aux["recurrents"]).reshape(T * B, recurrent_size)
         true_continue = (1 - batch["terminated"]).reshape(T * B, 1) * gamma
 
         def actor_loss_fn(actor_params):
+            actor_params = cast_floating(actor_params, cdt)
             latent0 = jnp.concatenate([posteriors, recurrents], axis=-1)
 
             def img_body(carry, key_t):
@@ -169,12 +175,16 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
             # reference: imagined_actions[0] = zeros, actions[i] precede state i
             imagined_actions = jnp.concatenate([jnp.zeros_like(actions_h[:1]), actions_h], axis=0)
 
-            predicted_target_values = critic_def.apply(params["target_critic"], imagined_trajectories)
-            predicted_rewards = world_model_def.apply(wm_params, imagined_trajectories, method="reward_logits")
+            predicted_target_values = critic_def.apply(
+                cast_floating(params["target_critic"], cdt), imagined_trajectories
+            ).astype(jnp.float32)
+            predicted_rewards = world_model_def.apply(
+                wm_params, imagined_trajectories, method="reward_logits"
+            ).astype(jnp.float32)
             if use_continues:
                 continues = jax.nn.sigmoid(
                     world_model_def.apply(wm_params, imagined_trajectories, method="continue_logits")
-                )
+                ).astype(jnp.float32)
                 continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
             else:
                 continues = jnp.ones_like(jax.lax.stop_gradient(predicted_rewards)) * gamma
@@ -223,7 +233,7 @@ def make_train_step(world_model_def, actor_def, critic_def, optimizers, cfg, act
         discount = aux2["discount"]
 
         def critic_loss_fn(critic_params):
-            values = critic_def.apply(critic_params, imagined_trajectories[:-1])
+            values = critic_def.apply(cast_floating(critic_params, cdt), imagined_trajectories[:-1])
             lp = normal_log_prob(values, lambda_values, 1)
             return -jnp.mean(discount[:-1, ..., 0] * lp)
 

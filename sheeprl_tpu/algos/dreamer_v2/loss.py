@@ -13,7 +13,10 @@ from sheeprl_tpu.ops.distributions import Bernoulli, kl_categorical
 
 
 def normal_log_prob(mean: jax.Array, value: jax.Array, event_dims: int) -> jax.Array:
-    """Independent(Normal(mean, 1)) log-prob summed over trailing event dims."""
+    """Independent(Normal(mean, 1)) log-prob summed over trailing event dims.
+    Computed in fp32 regardless of input dtype (mixed-precision loss boundary)."""
+    mean = mean.astype(jnp.float32)
+    value = value.astype(jnp.float32)
     lp = -0.5 * (value - mean) ** 2 - 0.5 * jnp.log(2 * jnp.pi)
     return jnp.sum(lp, axis=tuple(range(-event_dims, 0)))
 

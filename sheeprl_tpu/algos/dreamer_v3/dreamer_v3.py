@@ -54,7 +54,8 @@ from sheeprl_tpu.ops.distributions import (
     TwoHotEncodingDistribution,
 )
 from sheeprl_tpu.ops.numerics import compute_lambda_values
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, prefetch_staged
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -81,6 +82,7 @@ def make_train_step(
     ``"data"``, grads pmean'd, Moments quantiles all-gathered.
     """
     axis = dp_axis(mesh)
+    cdt = compute_dtype_of(cfg)  # bf16 under fabric.precision=bf16-*
     wm_cfg = cfg.algo.world_model
     stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
     recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
@@ -99,15 +101,20 @@ def make_train_step(
             lambda c, t: tau * c + (1 - tau) * t, params["critic"], params["target_critic"]
         )
 
-        batch_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}
+        # loss-side targets stay fp32; the compute path runs in `cdt` via the
+        # JMP-style casts at each loss entry (params + inputs -> cdt, flax
+        # promotes, distributions upcast back to fp32 at the loss boundary)
+        target_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}  # fp32 targets
+        batch_obs = cast_floating(target_obs, cdt)  # network input
         # shift actions right by one: a_0 = 0 (reference dreamer_v3.py:104-105)
         batch_actions = jnp.concatenate(
             [jnp.zeros_like(batch["actions"][:1]), batch["actions"][:-1]], axis=0
-        )
-        is_first = batch["is_first"].at[0].set(1.0)
+        ).astype(cdt)
+        is_first = batch["is_first"].at[0].set(1.0).astype(cdt)
 
         # ---------------- DYNAMIC LEARNING ---------------------------------
         def wm_loss_fn(wm_params):
+            wm_params = cast_floating(wm_params, cdt)
             embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
 
             def scan_body(carry, x):
@@ -119,7 +126,7 @@ def make_train_step(
                 return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
             keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stoch_flat)), jnp.zeros((B, recurrent_size)))
+            init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
             _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
                 scan_body, init, (batch_actions, embedded, is_first, keys_t)
             )
@@ -140,7 +147,7 @@ def make_train_step(
             ql = post_logits.reshape(T, B, wm_cfg.stochastic_size, wm_cfg.discrete_size)
             rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
                 po,
-                batch_obs,
+                target_obs,
                 pr,
                 batch["rewards"],
                 pl,
@@ -175,12 +182,13 @@ def make_train_step(
 
         # ---------------- BEHAVIOUR LEARNING -------------------------------
         # (uses the freshly updated world model, like the reference)
-        wm_params = params["world_model"]
+        wm_params = cast_floating(params["world_model"], cdt)
         posteriors = jax.lax.stop_gradient(aux["posteriors"]).reshape(T * B, stoch_flat)
         recurrents = jax.lax.stop_gradient(aux["recurrents"]).reshape(T * B, recurrent_size)
         true_continue = (1 - batch["terminated"]).reshape(T * B, 1)
 
         def actor_loss_fn(actor_params, moments_state):
+            actor_params = cast_floating(actor_params, cdt)
             latent0 = jnp.concatenate([posteriors, recurrents], axis=-1)
             a0 = actor_def.apply(actor_params, jax.lax.stop_gradient(latent0), k_img_actions, False, method="act")
 
@@ -202,7 +210,7 @@ def make_train_step(
             imagined_actions = jnp.concatenate([a0[None], actions_h], axis=0)
 
             predicted_values = TwoHotEncodingDistribution(
-                critic_def.apply(params["critic"], imagined_trajectories), dims=1
+                critic_def.apply(cast_floating(params["critic"], cdt), imagined_trajectories), dims=1
             ).mean
             predicted_rewards = TwoHotEncodingDistribution(
                 world_model_def.apply(wm_params, imagined_trajectories, method="reward_logits"), dims=1
@@ -269,10 +277,11 @@ def make_train_step(
 
         def critic_loss_fn(critic_params):
             qv = TwoHotEncodingDistribution(
-                critic_def.apply(critic_params, imagined_trajectories[:-1]), dims=1
+                critic_def.apply(cast_floating(critic_params, cdt), imagined_trajectories[:-1]), dims=1
             )
             predicted_target_values = TwoHotEncodingDistribution(
-                critic_def.apply(params["target_critic"], imagined_trajectories[:-1]), dims=1
+                critic_def.apply(cast_floating(params["target_critic"], cdt), imagined_trajectories[:-1]),
+                dims=1,
             ).mean
             value_loss = -qv.log_prob(lambda_values)
             value_loss = value_loss - qv.log_prob(jax.lax.stop_gradient(predicted_target_values))
@@ -473,6 +482,9 @@ def _dreamer_main(
     world_model_def, actor_def, critic_def, params = build_agent_fn(
         runtime, actions_dim, is_continuous, cfg, observation_space, agent_state
     )
+    # bf16-true stores the weights themselves in bf16; *-mixed keeps fp32
+    # master weights and casts per-loss inside the train step
+    params = cast_floating(params, runtime.param_dtype)
     player = player_cls(world_model_def, actor_def, actions_dim, num_envs)
 
     if make_optimizers_fn is None:
@@ -671,26 +683,33 @@ def _dreamer_main(
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )
+                def _normalize(staged):
+                    # runs on device arrays (raw uint8 over the wire)
+                    batch = {}
+                    for k, arr in staged.items():
+                        arr = arr.astype(jnp.float32)
+                        if k in cnn_keys:
+                            arr = arr / 255.0 - 0.5
+                        batch[k] = arr
+                    return batch
+
                 with timer("Time/train_time"):
-                    for i in range(per_rank_gradient_steps):
+                    # double-buffered staging: batch i+1 is device_put
+                    # (async) while the device executes step i — the
+                    # host-gather + transfer hide behind compute
+                    batches = prefetch_staged(
+                        local_data,
+                        per_rank_gradient_steps,
+                        runtime.mesh if world_size > 1 else None,
+                        batch_axis=1,
+                        transform=_normalize,
+                    )
+                    for batch in batches:
                         target_freq = cfg.algo.critic.get("per_rank_target_network_update_freq", 0)
                         if target_freq and cumulative_grad_steps % target_freq == 0:
                             tau = 1.0 if cumulative_grad_steps == 0 else cfg.algo.critic.get("tau", 1.0)
                         else:
                             tau = 0.0
-                        # stage [T, B_total, ...] with B sharded over the mesh
-                        # (raw dtype over PCIe; cast/normalize run sharded)
-                        staged = stage(
-                            {k: np.asarray(v[i]) for k, v in local_data.items()},
-                            runtime.mesh if world_size > 1 else None,
-                            batch_axis=1,
-                        )
-                        batch = {}
-                        for k, arr in staged.items():
-                            arr = arr.astype(jnp.float32)
-                            if k in cnn_keys:
-                                arr = arr / 255.0 - 0.5
-                            batch[k] = arr
                         rng_key, train_key = jax.random.split(rng_key)
                         params, opt_states, moments_state, metrics = train_step(
                             params, opt_states, moments_state, batch, train_key, jnp.float32(tau)
@@ -746,6 +765,7 @@ def _dreamer_main(
             )
 
     envs.close()
+    cumulative_rew = None
     if runtime.is_global_zero and cfg.algo.run_test:
         if final_test_fn is None:
             cumulative_rew = test(
@@ -759,3 +779,4 @@ def _dreamer_main(
 
         log_models(cfg, params, log_dir)
     logger.finalize()
+    return cumulative_rew

@@ -32,6 +32,7 @@ from sheeprl_tpu.ops.distributions import (
 )
 from sheeprl_tpu.ops.numerics import compute_lambda_values
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 
 _HEADS = {}  # filled by the wrapped build_agent; keyed per-process (single controller)
@@ -68,6 +69,7 @@ def make_train_step(
     mesh=None,
 ):
     axis = dp_axis(mesh)
+    cdt = compute_dtype_of(cfg)
     wm_cfg = cfg.algo.world_model
     stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
     recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
@@ -91,7 +93,8 @@ def make_train_step(
             lambda c, t: tau * c + (1 - tau) * t, params["critic"], params["target_critic"]
         )
 
-        batch_obs = {k: batch[k] for k in set(cnn_keys + mlp_keys)}
+        target_obs = {k: batch[k] for k in set(cnn_keys + mlp_keys)}  # fp32 targets
+        batch_obs = cast_floating(target_obs, cdt)
         # JEPA views need (T,B,C,H,W) pixels / (T,B,D) vectors
         view_obs = {k: batch_obs[k] for k in batch_obs}
         obs_q, obs_k = make_two_views(
@@ -99,11 +102,13 @@ def make_train_step(
         )
         batch_actions = jnp.concatenate(
             [jnp.zeros_like(batch["actions"][:1]), batch["actions"][:-1]], axis=0
-        )
-        is_first = batch["is_first"].at[0].set(1.0)
+        ).astype(cdt)
+        is_first = batch["is_first"].at[0].set(1.0).astype(cdt)
 
         def wm_loss_fn(combined):
             wm_params, jepa_online = combined
+            wm_params = cast_floating(wm_params, cdt)
+            jepa_online = cast_floating(jepa_online, cdt)
             embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
 
             def scan_body(carry, x):
@@ -115,7 +120,7 @@ def make_train_step(
                 return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
             keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stoch_flat)), jnp.zeros((B, recurrent_size)))
+            init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
             _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
                 scan_body, init, (batch_actions, embedded, is_first, keys_t)
             )
@@ -134,7 +139,7 @@ def make_train_step(
             ql = post_logits.reshape(T, B, wm_cfg.stochastic_size, wm_cfg.discrete_size)
             rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
                 po,
-                {k: batch_obs[k] for k in set(cnn_dec_keys + mlp_dec_keys)},
+                {k: target_obs[k] for k in set(cnn_dec_keys + mlp_dec_keys)},
                 pr,
                 batch["rewards"],
                 pl,
@@ -150,12 +155,14 @@ def make_train_step(
             # --- JEPA auxiliary objective (reference :230-231) ------------
             jl = jepa_loss(
                 lambda o: world_model_def.apply(wm_params, o, method="encode"),
-                lambda o: world_model_def.apply(params["jepa"]["target_encoder"], o, method="encode"),
+                lambda o: world_model_def.apply(
+                    cast_floating(params["jepa"]["target_encoder"], cdt), o, method="encode"
+                ),
                 projector_def,
                 predictor_def,
                 jepa_online["projector"],
                 jepa_online["predictor"],
-                params["jepa"]["target_projector"],
+                cast_floating(params["jepa"]["target_projector"], cdt),
                 obs_q,
                 obs_k,
             )
@@ -196,12 +203,13 @@ def make_train_step(
         )
 
         # ---------------- BEHAVIOUR LEARNING (same as DV3) -----------------
-        wm_params = params["world_model"]
+        wm_params = cast_floating(params["world_model"], cdt)
         posteriors = jax.lax.stop_gradient(aux["posteriors"]).reshape(T * B, stoch_flat)
         recurrents = jax.lax.stop_gradient(aux["recurrents"]).reshape(T * B, recurrent_size)
         true_continue = (1 - batch["terminated"]).reshape(T * B, 1)
 
         def actor_loss_fn(actor_params, moments_state):
+            actor_params = cast_floating(actor_params, cdt)
             latent0 = jnp.concatenate([posteriors, recurrents], axis=-1)
             a0 = actor_def.apply(actor_params, jax.lax.stop_gradient(latent0), k_img_actions, False, method="act")
 
@@ -223,7 +231,7 @@ def make_train_step(
             imagined_actions = jnp.concatenate([a0[None], actions_h], axis=0)
 
             predicted_values = TwoHotEncodingDistribution(
-                critic_def.apply(params["critic"], imagined_trajectories), dims=1
+                critic_def.apply(cast_floating(params["critic"], cdt), imagined_trajectories), dims=1
             ).mean
             predicted_rewards = TwoHotEncodingDistribution(
                 world_model_def.apply(wm_params, imagined_trajectories, method="reward_logits"), dims=1
@@ -285,9 +293,12 @@ def make_train_step(
         discount = aux2["discount"]
 
         def critic_loss_fn(critic_params):
-            qv = TwoHotEncodingDistribution(critic_def.apply(critic_params, imagined_trajectories[:-1]), dims=1)
+            qv = TwoHotEncodingDistribution(
+                critic_def.apply(cast_floating(critic_params, cdt), imagined_trajectories[:-1]), dims=1
+            )
             predicted_target_values = TwoHotEncodingDistribution(
-                critic_def.apply(params["target_critic"], imagined_trajectories[:-1]), dims=1
+                critic_def.apply(cast_floating(params["target_critic"], cdt), imagined_trajectories[:-1]),
+                dims=1,
             ).mean
             value_loss = -qv.log_prob(lambda_values)
             value_loss = value_loss - qv.log_prob(jax.lax.stop_gradient(predicted_target_values))

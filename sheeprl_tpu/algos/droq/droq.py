@@ -30,6 +30,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -39,6 +40,7 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: float, mesh=None):
     axis = dp_axis(mesh)
+    cdt = compute_dtype_of(cfg)
     tau = cfg.algo.tau
     gamma = cfg.algo.gamma
 
@@ -47,29 +49,32 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: floa
         batch, actor_batch, key = inp
         key = fold_key(key, axis)
         k_next, k_drop, k_actor, k_drop2 = jax.random.split(key, 4)
+        obs_c = cast_floating(batch["observations"], cdt)
+        next_obs_c = cast_floating(batch["next_observations"], cdt)
+        actor_obs_c = cast_floating(actor_batch["observations"], cdt)
 
         # --- critic update (reference droq.py:95-120) ---------------------
         next_actions, next_logprobs = actor_def.apply(
-            params["actor"], batch["next_observations"], k_next, method="sample_and_log_prob"
+            cast_floating(params["actor"], cdt), next_obs_c, k_next, method="sample_and_log_prob"
         )
         next_q = critic_def.apply(
-            params["target_critic"], batch["next_observations"], next_actions, True
-        )
+            cast_floating(params["target_critic"], cdt), next_obs_c, next_actions, True
+        ).astype(jnp.float32)
         min_next_q = jnp.min(next_q, axis=-1, keepdims=True)
         alpha = jnp.exp(params["log_alpha"])
         next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * (
-            min_next_q - alpha * next_logprobs
+            min_next_q - alpha * next_logprobs.astype(jnp.float32)
         )
         next_qf_value = jax.lax.stop_gradient(next_qf_value)
 
         def qf_loss_fn(critic_params):
             qf_values = critic_def.apply(
-                critic_params,
-                batch["observations"],
-                batch["actions"],
+                cast_floating(critic_params, cdt),
+                obs_c,
+                cast_floating(batch["actions"], cdt),
                 False,
                 rngs={"dropout": k_drop},
-            )
+            ).astype(jnp.float32)
             return jnp.sum(jnp.mean((qf_values - next_qf_value) ** 2, axis=tuple(range(qf_values.ndim - 1))))
 
         qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
@@ -83,14 +88,14 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: floa
         # --- actor update on its own batch (reference droq.py:122-131) ----
         def actor_loss_fn(actor_params):
             actions, logprobs = actor_def.apply(
-                actor_params, actor_batch["observations"], k_actor, method="sample_and_log_prob"
+                cast_floating(actor_params, cdt), actor_obs_c, k_actor, method="sample_and_log_prob"
             )
             q = critic_def.apply(
-                params["critic"], actor_batch["observations"], actions, False, rngs={"dropout": k_drop2}
-            )
+                cast_floating(params["critic"], cdt), actor_obs_c, actions, False, rngs={"dropout": k_drop2}
+            ).astype(jnp.float32)
             mean_q = jnp.mean(q, axis=-1, keepdims=True)
             alpha = jnp.exp(params["log_alpha"])
-            return policy_loss(alpha, logprobs, mean_q), logprobs
+            return policy_loss(alpha, logprobs.astype(jnp.float32), mean_q), logprobs
 
         (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
         actor_grads = pmean_tree(actor_grads, axis)
@@ -157,6 +162,7 @@ def main(runtime, cfg):
     actor_def, critic_def, params, target_entropy = build_agent(
         runtime, cfg, observation_space, action_space, state["agent"] if state else None
     )
+    params = cast_floating(params, runtime.param_dtype)
     optimizers = {
         "actor": instantiate(cfg.algo.actor.optimizer),
         "critic": instantiate(cfg.algo.critic.optimizer),

@@ -26,6 +26,7 @@ from sheeprl_tpu.algos.p2e_dv1.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER 
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.ops.distributions import Bernoulli
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 
 _P2E = {"ensemble_def": None}
@@ -65,6 +66,7 @@ def make_train_step(
     mesh=None,
 ):
     axis = dp_axis(mesh)
+    cdt = compute_dtype_of(cfg)
     ensemble_def = _P2E["ensemble_def"]
     wm_cfg = cfg.algo.world_model
     stochastic_size = wm_cfg.stochastic_size
@@ -104,10 +106,13 @@ def make_train_step(
         key = fold_key(key, axis)
         k_wm, k_img_e, k_img_t = jax.random.split(key, 3)
 
-        batch_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}
+        target_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}  # fp32 targets
+        batch_obs = cast_floating(target_obs, cdt)
+        batch_actions = cast_floating(batch["actions"], cdt)
 
         # ---------------- DYNAMIC LEARNING (as DV1) ------------------------
         def wm_loss_fn(wm_params):
+            wm_params = cast_floating(wm_params, cdt)
             embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
 
             def scan_body(carry, x):
@@ -119,9 +124,9 @@ def make_train_step(
                 return (posterior, recurrent), (recurrent, posterior, post_ms, prior_ms)
 
             keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stochastic_size)), jnp.zeros((B, recurrent_size)))
+            init = (jnp.zeros((B, stochastic_size), cdt), jnp.zeros((B, recurrent_size), cdt))
             _, (recurrents, posteriors, post_ms, prior_ms) = jax.lax.scan(
-                scan_body, init, (batch["actions"], embedded, keys_t)
+                scan_body, init, (batch_actions, embedded, keys_t)
             )
             latents = jnp.concatenate([posteriors, recurrents], axis=-1)
             recon = world_model_def.apply(wm_params, latents, method="decode")
@@ -135,7 +140,7 @@ def make_train_step(
                 qc = continues_targets = None
             rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
                 recon,
-                batch_obs,
+                target_obs,
                 reward_mean,
                 batch["rewards"],
                 post_ms,
@@ -164,7 +169,7 @@ def make_train_step(
             wm_grads, opt_states["world_model"], params["world_model"]
         )
         params["world_model"] = optax.apply_updates(params["world_model"], updates)
-        wm_params = params["world_model"]
+        wm_params = cast_floating(params["world_model"], cdt)
 
         posteriors = jax.lax.stop_gradient(aux["posteriors"])  # [T, B, S]
         recurrents = jax.lax.stop_gradient(aux["recurrents"])
@@ -172,8 +177,8 @@ def make_train_step(
 
         # ---------------- ENSEMBLE LEARNING (reference :165-185) -----------
         def ens_loss_fn(ens_params):
-            inp = jnp.concatenate([posteriors, recurrents, batch["actions"]], axis=-1)
-            outs = ensembles_apply(ens_params, inp)[:, :-1]  # [N, T-1, B, E]
+            inp = jnp.concatenate([posteriors, recurrents, batch_actions], axis=-1)
+            outs = ensembles_apply(cast_floating(ens_params, cdt), inp)[:, :-1]  # [N, T-1, B, E]
             target = jnp.broadcast_to(embedded[1:][None], outs.shape)
             lp = normal_log_prob(outs, target, 1)
             return -jnp.mean(lp, axis=(1, 2)).sum()
@@ -190,18 +195,23 @@ def make_train_step(
 
         # ---------------- EXPLORATION BEHAVIOUR (reference :186-265) -------
         def actor_expl_loss_fn(actor_params):
+            actor_params = cast_floating(actor_params, cdt)
             trajectories, actions = imagine(wm_params, actor_params, flat_post, flat_rec, k_img_e)
-            values = critic_def.apply(params["critic_exploration"], trajectories)
+            values = critic_def.apply(
+                cast_floating(params["critic_exploration"], cdt), trajectories
+            ).astype(jnp.float32)
 
             ens_in = jax.lax.stop_gradient(jnp.concatenate([trajectories, actions], axis=-1))
-            preds = ensembles_apply(params["ensembles"], ens_in)  # [N, H, TB, E]
+            preds = ensembles_apply(cast_floating(params["ensembles"], cdt), ens_in).astype(
+                jnp.float32
+            )  # [N, H, TB, E]
             intrinsic_reward = (
                 jnp.var(preds, axis=0, ddof=1).mean(-1, keepdims=True) * intrinsic_mult
             )
             if use_continues:
                 continues = jax.nn.sigmoid(
                     world_model_def.apply(wm_params, trajectories, method="continue_logits")
-                )
+                ).astype(jnp.float32)
             else:
                 continues = jnp.ones_like(jax.lax.stop_gradient(intrinsic_reward)) * gamma
 
@@ -236,7 +246,7 @@ def make_train_step(
         params["actor_exploration"] = optax.apply_updates(params["actor_exploration"], updates)
 
         def critic_expl_loss_fn(critic_params):
-            values = critic_def.apply(critic_params, aux_e["trajectories"])[:-1]
+            values = critic_def.apply(cast_floating(critic_params, cdt), aux_e["trajectories"])[:-1]
             lp = normal_log_prob(values, aux_e["lambda_values"], 1)
             return -jnp.mean(aux_e["discount"][..., 0] * lp)
 
@@ -251,13 +261,18 @@ def make_train_step(
 
         # ---------------- TASK BEHAVIOUR (zero-shot, as DV1) ---------------
         def actor_task_loss_fn(actor_params):
+            actor_params = cast_floating(actor_params, cdt)
             trajectories, _ = imagine(wm_params, actor_params, flat_post, flat_rec, k_img_t)
-            values = critic_def.apply(params["critic_task"], trajectories)
-            rewards = world_model_def.apply(wm_params, trajectories, method="reward_logits")
+            values = critic_def.apply(cast_floating(params["critic_task"], cdt), trajectories).astype(
+                jnp.float32
+            )
+            rewards = world_model_def.apply(wm_params, trajectories, method="reward_logits").astype(
+                jnp.float32
+            )
             if use_continues:
                 continues = jax.nn.sigmoid(
                     world_model_def.apply(wm_params, trajectories, method="continue_logits")
-                )
+                ).astype(jnp.float32)
             else:
                 continues = jnp.ones_like(jax.lax.stop_gradient(rewards)) * gamma
             lambda_values = compute_lambda_values(
@@ -289,7 +304,7 @@ def make_train_step(
         params["actor_task"] = optax.apply_updates(params["actor_task"], updates)
 
         def critic_task_loss_fn(critic_params):
-            values = critic_def.apply(critic_params, aux_t["trajectories"])[:-1]
+            values = critic_def.apply(cast_floating(critic_params, cdt), aux_t["trajectories"])[:-1]
             lp = normal_log_prob(values, aux_t["lambda_values"], 1)
             return -jnp.mean(aux_t["discount"][..., 0] * lp)
 

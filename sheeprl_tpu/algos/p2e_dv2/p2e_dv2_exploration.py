@@ -27,6 +27,7 @@ from sheeprl_tpu.algos.p2e_dv2.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER 
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.ops.distributions import Bernoulli
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 
 _P2E = {"ensemble_def": None}
@@ -66,6 +67,7 @@ def make_train_step(
     mesh=None,
 ):
     axis = dp_axis(mesh)
+    cdt = compute_dtype_of(cfg)
     ensemble_def = _P2E["ensemble_def"]
     wm_cfg = cfg.algo.world_model
     stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
@@ -117,11 +119,14 @@ def make_train_step(
             params["target_critic_exploration"],
         )
 
-        batch_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}
-        is_first = batch["is_first"].at[0].set(1.0)
+        target_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}  # fp32 targets
+        batch_obs = cast_floating(target_obs, cdt)
+        batch_actions = cast_floating(batch["actions"], cdt)
+        is_first = batch["is_first"].at[0].set(1.0).astype(cdt)
 
         # ---------------- DYNAMIC LEARNING (as DV2) ------------------------
         def wm_loss_fn(wm_params):
+            wm_params = cast_floating(wm_params, cdt)
             embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
 
             def scan_body(carry, x):
@@ -133,9 +138,9 @@ def make_train_step(
                 return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
             keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stoch_flat)), jnp.zeros((B, recurrent_size)))
+            init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
             _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
-                scan_body, init, (batch["actions"], embedded, is_first, keys_t)
+                scan_body, init, (batch_actions, embedded, is_first, keys_t)
             )
             latents = jnp.concatenate([posteriors, recurrents], axis=-1)
             recon = world_model_def.apply(wm_params, latents, method="decode")
@@ -151,7 +156,7 @@ def make_train_step(
             ql = post_logits.reshape(T, B, wm_cfg.stochastic_size, wm_cfg.discrete_size)
             rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
                 recon,
-                batch_obs,
+                target_obs,
                 reward_mean,
                 batch["rewards"],
                 pl,
@@ -181,15 +186,15 @@ def make_train_step(
             wm_grads, opt_states["world_model"], params["world_model"]
         )
         params["world_model"] = optax.apply_updates(params["world_model"], updates)
-        wm_params = params["world_model"]
+        wm_params = cast_floating(params["world_model"], cdt)
 
         posteriors = jax.lax.stop_gradient(aux["posteriors"])  # [T, B, S]
         recurrents = jax.lax.stop_gradient(aux["recurrents"])
 
         # ---------------- ENSEMBLE LEARNING (reference :196-221) -----------
         def ens_loss_fn(ens_params):
-            inp = jnp.concatenate([posteriors, recurrents, batch["actions"]], axis=-1)
-            outs = ensembles_apply(ens_params, inp)[:, :-1]  # [N, T-1, B, S]
+            inp = jnp.concatenate([posteriors, recurrents, batch_actions], axis=-1)
+            outs = ensembles_apply(cast_floating(ens_params, cdt), inp)[:, :-1]  # [N, T-1, B, S]
             target = jnp.broadcast_to(posteriors[1:][None], outs.shape)
             lp = normal_log_prob(outs, target, 1)
             return -jnp.mean(lp, axis=(1, 2)).sum()
@@ -207,18 +212,23 @@ def make_train_step(
 
         # ---------------- EXPLORATION BEHAVIOUR (reference :222-330) -------
         def actor_expl_loss_fn(actor_params):
+            actor_params = cast_floating(actor_params, cdt)
             trajectories, actions = imagine(wm_params, actor_params, flat_post, flat_rec, k_img_e)
-            target_values = critic_def.apply(params["target_critic_exploration"], trajectories)
+            target_values = critic_def.apply(
+                cast_floating(params["target_critic_exploration"], cdt), trajectories
+            ).astype(jnp.float32)
 
             ens_in = jax.lax.stop_gradient(jnp.concatenate([trajectories, actions], axis=-1))
-            preds = ensembles_apply(params["ensembles"], ens_in)  # [N, H+1, TB, S]
+            preds = ensembles_apply(cast_floating(params["ensembles"], cdt), ens_in).astype(
+                jnp.float32
+            )  # [N, H+1, TB, S]
             intrinsic_reward = (
                 jnp.var(preds, axis=0, ddof=1).mean(-1, keepdims=True) * intrinsic_mult
             )
             if use_continues:
                 continues = jax.nn.sigmoid(
                     world_model_def.apply(wm_params, trajectories, method="continue_logits")
-                )
+                ).astype(jnp.float32)
                 continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
             else:
                 continues = jnp.ones_like(jax.lax.stop_gradient(intrinsic_reward)) * gamma
@@ -268,7 +278,7 @@ def make_train_step(
         params["actor_exploration"] = optax.apply_updates(params["actor_exploration"], updates)
 
         def critic_expl_loss_fn(critic_params):
-            values = critic_def.apply(critic_params, aux_e["trajectories"][:-1])
+            values = critic_def.apply(cast_floating(critic_params, cdt), aux_e["trajectories"][:-1])
             lp = normal_log_prob(values, aux_e["lambda_values"], 1)
             return -jnp.mean(aux_e["discount"][:-1, ..., 0] * lp)
 
@@ -283,13 +293,18 @@ def make_train_step(
 
         # ---------------- TASK BEHAVIOUR (zero-shot, as DV2) ---------------
         def actor_task_loss_fn(actor_params):
+            actor_params = cast_floating(actor_params, cdt)
             trajectories, actions = imagine(wm_params, actor_params, flat_post, flat_rec, k_img_t)
-            target_values = critic_def.apply(params["target_critic_task"], trajectories)
-            rewards = world_model_def.apply(wm_params, trajectories, method="reward_logits")
+            target_values = critic_def.apply(
+                cast_floating(params["target_critic_task"], cdt), trajectories
+            ).astype(jnp.float32)
+            rewards = world_model_def.apply(wm_params, trajectories, method="reward_logits").astype(
+                jnp.float32
+            )
             if use_continues:
                 continues = jax.nn.sigmoid(
                     world_model_def.apply(wm_params, trajectories, method="continue_logits")
-                )
+                ).astype(jnp.float32)
                 continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
             else:
                 continues = jnp.ones_like(jax.lax.stop_gradient(rewards)) * gamma
@@ -333,7 +348,7 @@ def make_train_step(
         params["actor_task"] = optax.apply_updates(params["actor_task"], updates)
 
         def critic_task_loss_fn(critic_params):
-            values = critic_def.apply(critic_params, aux_t["trajectories"][:-1])
+            values = critic_def.apply(cast_floating(critic_params, cdt), aux_t["trajectories"][:-1])
             lp = normal_log_prob(values, aux_t["lambda_values"], 1)
             return -jnp.mean(aux_t["discount"][:-1, ..., 0] * lp)
 

@@ -47,6 +47,7 @@ from sheeprl_tpu.ops.distributions import (
 )
 from sheeprl_tpu.ops.numerics import compute_lambda_values
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 
 # filled by _build_agent before make_train_step runs (same single-controller
@@ -93,6 +94,7 @@ def make_train_step(
     mesh=None,
 ):
     axis = dp_axis(mesh)
+    cdt = compute_dtype_of(cfg)
     ensemble_def = _P2E["ensemble_def"]
     critics_spec = _P2E["critics_spec"]
     wm_cfg = cfg.algo.world_model
@@ -149,14 +151,16 @@ def make_train_step(
                 lambda cm, tm: tau * cm + (1 - tau) * tm, c["module"], c["target_module"]
             )
 
-        batch_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}
+        target_obs = {k: batch[k] for k in set(cnn_dec_keys + mlp_dec_keys)}  # fp32 targets
+        batch_obs = cast_floating(target_obs, cdt)
         batch_actions = jnp.concatenate(
             [jnp.zeros_like(batch["actions"][:1]), batch["actions"][:-1]], axis=0
-        )
-        is_first = batch["is_first"].at[0].set(1.0)
+        ).astype(cdt)
+        is_first = batch["is_first"].at[0].set(1.0).astype(cdt)
 
         # ---------------- 1) DYNAMIC LEARNING (as DV3) --------------------
         def wm_loss_fn(wm_params):
+            wm_params = cast_floating(wm_params, cdt)
             embedded = world_model_def.apply(wm_params, batch_obs, method="encode")
 
             def scan_body(carry, x):
@@ -168,7 +172,7 @@ def make_train_step(
                 return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
             keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stoch_flat)), jnp.zeros((B, recurrent_size)))
+            init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
             _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
                 scan_body, init, (batch_actions, embedded, is_first, keys_t)
             )
@@ -189,7 +193,7 @@ def make_train_step(
             ql = post_logits.reshape(T, B, wm_cfg.stochastic_size, wm_cfg.discrete_size)
             rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
                 po,
-                batch_obs,
+                target_obs,
                 pr,
                 batch["rewards"],
                 pl,
@@ -219,15 +223,15 @@ def make_train_step(
             wm_grads, opt_states["world_model"], params["world_model"]
         )
         params["world_model"] = optax.apply_updates(params["world_model"], updates)
-        wm_params = params["world_model"]
+        wm_params = cast_floating(params["world_model"], cdt)
 
         posteriors = jax.lax.stop_gradient(aux["posteriors"])  # [T, B, S]
         recurrents = jax.lax.stop_gradient(aux["recurrents"])  # [T, B, R]
 
         # ---------------- 2) ENSEMBLE LEARNING (reference :207-231) -------
         def ens_loss_fn(ens_params):
-            inp = jnp.concatenate([posteriors, recurrents, batch["actions"]], axis=-1)
-            outs = ensembles_apply(ens_params, inp)[:, :-1]  # [N, T-1, B, S]
+            inp = jnp.concatenate([posteriors, recurrents, batch["actions"].astype(cdt)], axis=-1)
+            outs = ensembles_apply(cast_floating(ens_params, cdt), inp)[:, :-1]  # [N, T-1, B, S]
             target = posteriors[1:]
             # sum over ensemble members of the MSE "log prob" loss
             lp = MSEDistribution(outs, dims=1).log_prob(
@@ -248,6 +252,7 @@ def make_train_step(
 
         # ---------------- 3) EXPLORATION BEHAVIOUR (reference :233-343) ----
         def actor_expl_loss_fn(actor_params, moments_expl):
+            actor_params = cast_floating(actor_params, cdt)
             trajectories, actions = imagine(wm_params, actor_params, flat_post, flat_rec, k_a0_e, k_img_e)
             continues = Bernoulli(
                 world_model_def.apply(wm_params, trajectories, method="continue_logits"), event_dims=1
@@ -258,7 +263,9 @@ def make_train_step(
             # intrinsic reward: ensemble disagreement (unbiased variance as
             # torch's Tensor.var, reference :259-263)
             ens_in = jax.lax.stop_gradient(jnp.concatenate([trajectories, actions], axis=-1))
-            preds = ensembles_apply(params["ensembles"], ens_in)  # [N, H+1, TB, S]
+            preds = ensembles_apply(cast_floating(params["ensembles"], cdt), ens_in).astype(
+                jnp.float32
+            )  # [N, H+1, TB, S]; disagreement variance in fp32
             intrinsic_reward = (
                 jnp.var(preds, axis=0, ddof=1).mean(-1, keepdims=True) * intrinsic_mult
             )
@@ -271,7 +278,10 @@ def make_train_step(
             critic_aux = {}
             for name, weight, reward_type in critics_spec:
                 values = TwoHotEncodingDistribution(
-                    critic_def.apply(params["critics_exploration"][name]["module"], trajectories), dims=1
+                    critic_def.apply(
+                        cast_floating(params["critics_exploration"][name]["module"], cdt), trajectories
+                    ),
+                    dims=1,
                 ).mean
                 reward = intrinsic_reward if reward_type == "intrinsic" else task_reward
                 lam = compute_lambda_values(
@@ -334,9 +344,14 @@ def make_train_step(
             lam = aux_e["critic_aux"][name]["lambda_values"]
 
             def critic_loss_fn(critic_params):
-                qv = TwoHotEncodingDistribution(critic_def.apply(critic_params, expl_traj[:-1]), dims=1)
+                qv = TwoHotEncodingDistribution(
+                    critic_def.apply(cast_floating(critic_params, cdt), expl_traj[:-1]), dims=1
+                )
                 target_vals = TwoHotEncodingDistribution(
-                    critic_def.apply(params["critics_exploration"][name]["target_module"], expl_traj[:-1]),
+                    critic_def.apply(
+                        cast_floating(params["critics_exploration"][name]["target_module"], cdt),
+                        expl_traj[:-1],
+                    ),
                     dims=1,
                 ).mean
                 loss = -qv.log_prob(lam) - qv.log_prob(jax.lax.stop_gradient(target_vals))
@@ -360,9 +375,10 @@ def make_train_step(
 
         # ---------------- 5) TASK BEHAVIOUR (zero-shot, reference :384-470) -
         def actor_task_loss_fn(actor_params, moments_task):
+            actor_params = cast_floating(actor_params, cdt)
             trajectories, actions = imagine(wm_params, actor_params, flat_post, flat_rec, k_a0_t, k_img_t)
             predicted_values = TwoHotEncodingDistribution(
-                critic_def.apply(params["critic_task"], trajectories), dims=1
+                critic_def.apply(cast_floating(params["critic_task"], cdt), trajectories), dims=1
             ).mean
             predicted_rewards = TwoHotEncodingDistribution(
                 world_model_def.apply(wm_params, trajectories, method="reward_logits"), dims=1
@@ -418,10 +434,11 @@ def make_train_step(
 
         def critic_task_loss_fn(critic_params):
             qv = TwoHotEncodingDistribution(
-                critic_def.apply(critic_params, aux_t["trajectories"][:-1]), dims=1
+                critic_def.apply(cast_floating(critic_params, cdt), aux_t["trajectories"][:-1]), dims=1
             )
             target_vals = TwoHotEncodingDistribution(
-                critic_def.apply(params["target_critic_task"], aux_t["trajectories"][:-1]), dims=1
+                critic_def.apply(cast_floating(params["target_critic_task"], cdt), aux_t["trajectories"][:-1]),
+                dims=1,
             ).mean
             loss = -qv.log_prob(aux_t["lambda_values"]) - qv.log_prob(jax.lax.stop_gradient(target_vals))
             return jnp.mean(loss * aux_t["discount"][:-1, ..., 0])

@@ -34,6 +34,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.ops.numerics import gae
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -52,11 +53,13 @@ def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, batch_siz
     """
     world = mesh.devices.size
     distributed = world > 1
+    cdt = compute_dtype_of(cfg)  # bf16 under fabric.precision=bf16-*
 
     def loss_fn(params, batch, clip_coef, ent_coef, vf_coef):
         _, new_logprobs, entropy, new_values = agent.apply(
-            params, batch["obs"], actions=batch["actions"]
+            cast_floating(params, cdt), cast_floating(batch["obs"], cdt), actions=batch["actions"]
         )
+        new_values = new_values.astype(jnp.float32)  # loss math in fp32
         advantages = batch["advantages"]
         if cfg.algo.normalize_advantages:
             mu = advantages.mean()
@@ -197,6 +200,8 @@ def main(runtime, cfg):
         observation_space,
         state["agent"] if state else None,
     )
+    # bf16-true: weights live in bf16; *-mixed keeps fp32 masters, casting per-loss
+    params = cast_floating(params, runtime.param_dtype)
     # lr annealing: bake a linear schedule into the optimizer's own step count
     # (reference anneals per-update on the host, ppo.py:230-263,415-424)
     policy_steps_per_iter = int(num_envs * rollout_steps)
@@ -433,6 +438,7 @@ def main(runtime, cfg):
 
     envs.close()
     # ---- final test episode (reference ppo.py:445-453) --------------------
+    cumulative_rew = None
     if runtime.is_global_zero and cfg.algo.run_test:
         test_env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
         cumulative_rew = test(agent.apply, params, test_env, runtime, cfg, log_dir)
@@ -443,3 +449,4 @@ def main(runtime, cfg):
 
         log_models(cfg, {"agent": params}, log_dir)
     logger.finalize()
+    return cumulative_rew

@@ -37,6 +37,7 @@ from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.ops.numerics import gae
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
@@ -104,6 +105,7 @@ def main(runtime, cfg):
     agent, params, _ = build_agent(
         runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
     )
+    params = cast_floating(params, runtime.param_dtype)
 
     policy_steps_per_iter = int(num_envs * rollout_steps)
     total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1

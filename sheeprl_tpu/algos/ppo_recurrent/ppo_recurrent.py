@@ -37,6 +37,7 @@ from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.ops.numerics import gae
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
@@ -47,18 +48,20 @@ def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, seq_batch
     ``[L, S, ...]`` with S sequences sharded over the mesh."""
     world = mesh.devices.size
     distributed = world > 1
+    cdt = compute_dtype_of(cfg)
     obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
 
     def loss_fn(params, batch, clip_coef, ent_coef, vf_coef):
         _, new_logprobs, entropy, new_values, _ = agent.apply(
-            params,
-            {k: batch[k] for k in obs_keys},
-            batch["prev_actions"],
-            batch["hx0"][0],
-            batch["cx0"][0],
+            cast_floating(params, cdt),
+            cast_floating({k: batch[k] for k in obs_keys}, cdt),
+            cast_floating(batch["prev_actions"], cdt),
+            cast_floating(batch["hx0"][0], cdt),
+            cast_floating(batch["cx0"][0], cdt),
             resets=batch["resets"],
             actions=batch["actions"],
         )
+        new_values = new_values.astype(jnp.float32)
         advantages = batch["advantages"]
         if cfg.algo.normalize_advantages:
             mu, std = advantages.mean(), advantages.std()
@@ -176,6 +179,7 @@ def main(runtime, cfg):
     agent, params, _ = build_agent(
         runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
     )
+    params = cast_floating(params, runtime.param_dtype)
     policy_steps_per_iter = int(num_envs * rollout_steps)
     total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
     if cfg.algo.anneal_lr:

@@ -28,6 +28,7 @@ from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, pre
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -40,24 +41,32 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
     world = mesh.devices.size
     distributed = world > 1
     tau = cfg.algo.tau
+    cdt = compute_dtype_of(cfg)
 
     def one_step(carry, inp):
         params, opt_states = carry
         batch, key = inp
+        # network inputs in the compute dtype; TD targets stay fp32
+        obs_c = cast_floating(batch["observations"], cdt)
+        next_obs_c = cast_floating(batch["next_observations"], cdt)
 
         # --- critic update (reference sac.py:45-53) -----------------------
         def qf_loss_fn(critic_params):
             next_actions, next_logprobs = actor_def.apply(
-                params["actor"], batch["next_observations"], key, method="sample_and_log_prob"
+                cast_floating(params["actor"], cdt), next_obs_c, key, method="sample_and_log_prob"
             )
-            next_q = critic_def.apply(params["target_critic"], batch["next_observations"], next_actions)
+            next_q = critic_def.apply(
+                cast_floating(params["target_critic"], cdt), next_obs_c, next_actions
+            ).astype(jnp.float32)
             min_next_q = jnp.min(next_q, axis=-1, keepdims=True)
             alpha = jnp.exp(params["log_alpha"])
             next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * cfg.algo.gamma * (
-                min_next_q - alpha * next_logprobs
+                min_next_q - alpha * next_logprobs.astype(jnp.float32)
             )
             next_qf_value = jax.lax.stop_gradient(next_qf_value)
-            qf_values = critic_def.apply(critic_params, batch["observations"], batch["actions"])
+            qf_values = critic_def.apply(
+                cast_floating(critic_params, cdt), obs_c, cast_floating(batch["actions"], cdt)
+            ).astype(jnp.float32)
             return critic_loss(qf_values, next_qf_value, cfg.algo.critic.n)
 
         qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
@@ -77,12 +86,14 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
         # --- actor update (reference sac.py:59-66) ------------------------
         def actor_loss_fn(actor_params):
             actions, logprobs = actor_def.apply(
-                actor_params, batch["observations"], key, method="sample_and_log_prob"
+                cast_floating(actor_params, cdt), obs_c, key, method="sample_and_log_prob"
             )
-            q = critic_def.apply(params["critic"], batch["observations"], actions)
+            q = critic_def.apply(cast_floating(params["critic"], cdt), obs_c, actions).astype(
+                jnp.float32
+            )
             min_q = jnp.min(q, axis=-1, keepdims=True)
             alpha = jnp.exp(params["log_alpha"])
-            return policy_loss(alpha, logprobs, min_q), logprobs
+            return policy_loss(alpha, logprobs.astype(jnp.float32), min_q), logprobs
 
         (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
         if distributed:
@@ -165,6 +176,7 @@ def main(runtime, cfg):
     actor_def, critic_def, params, target_entropy = build_agent(
         runtime, cfg, observation_space, action_space, state["agent"] if state else None
     )
+    params = cast_floating(params, runtime.param_dtype)
     optimizers = {
         "actor": instantiate(cfg.algo.actor.optimizer),
         "critic": instantiate(cfg.algo.critic.optimizer),

@@ -29,6 +29,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
@@ -80,6 +81,7 @@ def main(runtime, cfg):
     actor_def, critic_def, params, target_entropy = build_agent(
         runtime, cfg, observation_space, action_space, state["agent"] if state else None
     )
+    params = cast_floating(params, runtime.param_dtype)
     optimizers = {
         "actor": instantiate(cfg.algo.actor.optimizer),
         "critic": instantiate(cfg.algo.critic.optimizer),

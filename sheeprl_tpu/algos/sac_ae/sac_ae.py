@@ -34,6 +34,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
+from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -43,6 +44,7 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers, cfg, target_entropy, mesh=None):
     axis = dp_axis(mesh)
+    cdt = compute_dtype_of(cfg)
     gamma = cfg.algo.gamma
     tau = cfg.algo.tau
     encoder_tau = cfg.algo.encoder.tau
@@ -61,30 +63,35 @@ def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers,
         key = fold_key(key, axis)
         k_next, k_actor, k_noise = jax.random.split(key, 3)
 
-        obs = {k: batch[k] / 255.0 for k in cnn_keys}
-        obs.update({k: batch[k] for k in mlp_keys})
-        next_obs = {k: batch[f"next_{k}"] / 255.0 for k in cnn_keys}
-        next_obs.update({k: batch[f"next_{k}"] for k in mlp_keys})
+        obs = {k: (batch[k] / 255.0).astype(cdt) for k in cnn_keys}
+        obs.update({k: cast_floating(batch[k], cdt) for k in mlp_keys})
+        next_obs = {k: (batch[f"next_{k}"] / 255.0).astype(cdt) for k in cnn_keys}
+        next_obs.update({k: cast_floating(batch[f"next_{k}"], cdt) for k in mlp_keys})
 
         # --- critic (+ encoder) update (reference sac_ae.py:62-71) --------
-        next_features = encoder_def.apply(params["target_encoder"], next_obs)
+        next_features = encoder_def.apply(cast_floating(params["target_encoder"], cdt), next_obs)
         next_actions, next_logprobs = actor_def.apply(
-            params["actor"],
-            encoder_def.apply(params["encoder"], next_obs),
+            cast_floating(params["actor"], cdt),
+            encoder_def.apply(cast_floating(params["encoder"], cdt), next_obs),
             k_next,
             method="sample_and_log_prob",
         )
-        next_q = critic_def.apply(params["target_critic"], next_features, next_actions)
+        next_q = critic_def.apply(
+            cast_floating(params["target_critic"], cdt), next_features, next_actions
+        ).astype(jnp.float32)
         min_next_q = jnp.min(next_q, axis=-1, keepdims=True)
         alpha = jnp.exp(params["log_alpha"])
         next_qf_value = jax.lax.stop_gradient(
-            batch["rewards"] + (1 - batch["terminated"]) * gamma * (min_next_q - alpha * next_logprobs)
+            batch["rewards"]
+            + (1 - batch["terminated"]) * gamma * (min_next_q - alpha * next_logprobs.astype(jnp.float32))
         )
 
         def qf_loss_fn(enc_and_critic):
-            enc_params, critic_params = enc_and_critic
+            enc_params, critic_params = cast_floating(enc_and_critic, cdt)
             features = encoder_def.apply(enc_params, obs)
-            qf_values = critic_def.apply(critic_params, features, batch["actions"])
+            qf_values = critic_def.apply(
+                critic_params, features, cast_floating(batch["actions"], cdt)
+            ).astype(jnp.float32)
             return critic_loss(qf_values, next_qf_value, qf_values.shape[-1])
 
         qf_l, (enc_grads, critic_grads) = jax.value_and_grad(qf_loss_fn)(
@@ -114,15 +121,21 @@ def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers,
             params, opt_states = operand
             params = dict(params)
             opt_states = dict(opt_states)
-            features = jax.lax.stop_gradient(encoder_def.apply(params["encoder"], obs))
+            features = jax.lax.stop_gradient(
+                encoder_def.apply(cast_floating(params["encoder"], cdt), obs)
+            )
 
             def actor_loss_fn(actor_params):
                 actions, logprobs = actor_def.apply(
-                    actor_params, features, k_actor, method="sample_and_log_prob"
+                    cast_floating(actor_params, cdt), features, k_actor, method="sample_and_log_prob"
                 )
-                q = critic_def.apply(params["critic"], features, actions)
+                q = critic_def.apply(cast_floating(params["critic"], cdt), features, actions).astype(
+                    jnp.float32
+                )
                 min_q = jnp.min(q, axis=-1, keepdims=True)
-                return policy_loss(jnp.exp(params["log_alpha"]), logprobs, min_q), logprobs
+                return policy_loss(
+                    jnp.exp(params["log_alpha"]), logprobs.astype(jnp.float32), min_q
+                ), logprobs
 
             (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
                 params["actor"]
@@ -159,16 +172,17 @@ def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers,
             opt_states = dict(opt_states)
 
             def rec_loss_fn(enc_dec):
-                enc_params, dec_params = enc_dec
+                enc_params, dec_params = cast_floating(enc_dec, cdt)
                 hidden = encoder_def.apply(enc_params, obs)
                 recon = decoder_def.apply(dec_params, hidden)
+                hidden = hidden.astype(jnp.float32)
                 loss = 0.0
                 for k in cnn_dec + mlp_dec:
                     if k in cnn_dec:
                         target = preprocess_obs(batch[k], k_noise, bits=5)
                     else:
                         target = batch[k]
-                    loss = loss + jnp.mean((target - recon[k]) ** 2)
+                    loss = loss + jnp.mean((target - recon[k].astype(jnp.float32)) ** 2)
                     loss = loss + l2_lambda * jnp.mean(0.5 * jnp.sum(hidden**2, axis=-1))
                 return loss
 
@@ -246,6 +260,7 @@ def main(runtime, cfg):
     encoder_def, decoder_def, actor_def, critic_def, params, target_entropy = build_agent(
         runtime, cfg, observation_space, action_space, state["agent"] if state else None
     )
+    params = cast_floating(params, runtime.param_dtype)
     optimizers = {
         "actor": instantiate(cfg.algo.actor.optimizer),
         "critic": instantiate(cfg.algo.critic.optimizer),

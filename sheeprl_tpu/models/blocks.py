@@ -59,7 +59,7 @@ class MLP(nn.Module):
     norm_eps: float = 1e-3
     dropout: float = 0.0
     flatten_input: bool = False
-    dtype: Dtype = jnp.float32
+    dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
     output_kernel_init: Optional[Callable] = None
@@ -105,7 +105,7 @@ class CNN(nn.Module):
     layer_norm: bool = False
     norm_eps: float = 1e-3
     flatten_output: bool = True
-    dtype: Dtype = jnp.float32
+    dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -136,7 +136,7 @@ class DeCNN(nn.Module):
     layer_norm: bool = False
     norm_eps: float = 1e-3
     final_activation: Optional[str] = None
-    dtype: Dtype = jnp.float32
+    dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -163,7 +163,7 @@ class NatureCNN(nn.Module):
     """DQN-Nature conv backbone + dense head (reference models.py:288-328)."""
 
     features_dim: int = 512
-    dtype: Dtype = jnp.float32
+    dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -188,7 +188,7 @@ class LayerNormGRUCell(nn.Module):
     use_bias: bool = True
     layer_norm: bool = True
     norm_eps: float = 1e-3
-    dtype: Dtype = jnp.float32
+    dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
 
     @nn.compact

@@ -10,6 +10,10 @@ straight-through estimators built on ``stop_gradient``.
 Conventions:
 - ``sample(key)`` draws without gradient; ``rsample(key)`` reparameterizes.
 - ``log_prob(x)`` sums over declared event dims (like torch's Independent).
+- mixed precision: samples/modes keep the dtype of the parameters they were
+  built from (so bf16 stays bf16 through the RSSM hot path), while
+  ``log_prob``/``entropy``/KL and the value-reading heads (two-hot ``mean``)
+  compute in fp32 — the loss boundary is where bf16 error compounds.
 """
 
 from __future__ import annotations
@@ -27,6 +31,10 @@ def _sum_last_dims(x: jax.Array, dims: int) -> jax.Array:
     if dims == 0:
         return x
     return jnp.sum(x, axis=tuple(range(-dims, 0)))
+
+
+def _f32(x: jax.Array) -> jax.Array:
+    return x.astype(jnp.float32) if jnp.issubdtype(x.dtype, jnp.floating) else x
 
 
 class Normal:
@@ -56,12 +64,13 @@ class Normal:
     sample = rsample
 
     def log_prob(self, value: jax.Array) -> jax.Array:
-        var = self.scale**2
-        lp = -((value - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+        loc, scale, value = _f32(self.loc), _f32(self.scale), _f32(value)
+        var = scale**2
+        lp = -((value - loc) ** 2) / (2 * var) - jnp.log(scale) - 0.5 * math.log(2 * math.pi)
         return _sum_last_dims(lp, self.event_dims)
 
     def entropy(self) -> jax.Array:
-        ent = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        ent = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(_f32(self.scale))
         return _sum_last_dims(ent, self.event_dims)
 
 
@@ -84,7 +93,7 @@ class TanhNormal:
     def rsample_and_log_prob(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
         x = self.base.rsample(key)
         y = safetanh(x, self.eps)
-        lp = self.base.log_prob(x) - jnp.log1p(-(y**2) + self.eps)
+        lp = self.base.log_prob(x) - jnp.log1p(-(_f32(y) ** 2) + self.eps)
         return y, _sum_last_dims(lp, self.event_dims)
 
     def rsample(self, key: jax.Array) -> jax.Array:
@@ -93,6 +102,7 @@ class TanhNormal:
     sample = rsample
 
     def log_prob(self, value: jax.Array) -> jax.Array:
+        value = _f32(value)
         x = safeatanh(value, self.eps)
         lp = self.base.log_prob(x) - jnp.log1p(-(value**2) + self.eps)
         return _sum_last_dims(lp, self.event_dims)
@@ -144,8 +154,9 @@ class TruncatedNormal:
     sample = rsample
 
     def log_prob(self, value: jax.Array) -> jax.Array:
-        z = (value - self.loc) / self.scale
-        lp = -0.5 * z**2 - 0.5 * math.log(2 * math.pi) - jnp.log(self.scale) - jnp.log(self._Z)
+        loc, scale, value = _f32(self.loc), _f32(self.scale), _f32(value)
+        z = (value - loc) / scale
+        lp = -0.5 * z**2 - 0.5 * math.log(2 * math.pi) - jnp.log(scale) - jnp.log(_f32(self._Z))
         return _sum_last_dims(lp, self.event_dims)
 
     def entropy(self) -> jax.Array:
@@ -161,6 +172,7 @@ class Categorical:
     """Categorical over the last axis of ``logits``."""
 
     def __init__(self, logits: jax.Array):
+        logits = _f32(logits)
         self.logits = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
 
     @property
@@ -192,6 +204,10 @@ class OneHotCategorical:
     """
 
     def __init__(self, logits: jax.Array, event_dims: int = 0):
+        # normalize in fp32 (logsumexp in bf16 is lossy); samples are cast
+        # back to the construction dtype so bf16 RSSM latents stay bf16
+        self.dtype = logits.dtype
+        logits = _f32(logits)
         self.logits = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
         self.event_dims = event_dims
 
@@ -202,7 +218,7 @@ class OneHotCategorical:
     @property
     def mode(self) -> jax.Array:
         idx = jnp.argmax(self.logits, axis=-1)
-        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.logits.dtype)
+        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.dtype)
 
     @property
     def mean(self) -> jax.Array:
@@ -210,17 +226,17 @@ class OneHotCategorical:
 
     def sample(self, key: jax.Array) -> jax.Array:
         idx = jax.random.categorical(key, self.logits, axis=-1)
-        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.logits.dtype)
+        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.dtype)
 
     def rsample(self, key: jax.Array) -> jax.Array:
         """Straight-through gradient sample: forward = hard one-hot,
         backward = softmax probabilities (stop_gradient trick)."""
         hard = self.sample(key)
-        probs = self.probs
+        probs = self.probs.astype(self.dtype)
         return hard + probs - jax.lax.stop_gradient(probs)
 
     def straight_through(self, hard: jax.Array) -> jax.Array:
-        probs = self.probs
+        probs = self.probs.astype(self.dtype)
         return hard + probs - jax.lax.stop_gradient(probs)
 
     def log_prob(self, value: jax.Array) -> jax.Array:
@@ -237,6 +253,7 @@ def kl_categorical(p_logits: jax.Array, q_logits: jax.Array, event_dims: int = 0
     """KL(p || q) between categoricals over the last axis, summing ``event_dims``
     trailing batch dims (torch ``kl_divergence(Independent(OneHotCat...)...)``,
     used by DreamerV2/V3 KL balancing, reference algos/dreamer_v3/loss.py:70-83)."""
+    p_logits, q_logits = _f32(p_logits), _f32(q_logits)
     p_logits = p_logits - jax.nn.logsumexp(p_logits, axis=-1, keepdims=True)
     q_logits = q_logits - jax.nn.logsumexp(q_logits, axis=-1, keepdims=True)
     p = jax.nn.softmax(p_logits, axis=-1)
@@ -269,7 +286,8 @@ class Bernoulli:
 
     def log_prob(self, value: jax.Array) -> jax.Array:
         # -softplus(-l) for value 1, -softplus(l) for value 0 (numerically stable BCE)
-        lp = -jax.nn.softplus(-self.logits) * value - jax.nn.softplus(self.logits) * (1 - value)
+        logits, value = _f32(self.logits), _f32(value)
+        lp = -jax.nn.softplus(-logits) * value - jax.nn.softplus(logits) * (1 - value)
         return _sum_last_dims(lp, self.event_dims)
 
 
@@ -294,10 +312,11 @@ class SymlogDistribution:
 
     def log_prob(self, value: jax.Array) -> jax.Array:
         assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
+        mode, value = _f32(self._mode), _f32(value)
         if self._dist == "mse":
-            distance = (self._mode - symlog(value)) ** 2
+            distance = (mode - symlog(value)) ** 2
         elif self._dist == "abs":
-            distance = jnp.abs(self._mode - symlog(value))
+            distance = jnp.abs(mode - symlog(value))
         else:
             raise NotImplementedError(self._dist)
         distance = jnp.where(distance < self._tol, 0.0, distance)
@@ -325,7 +344,8 @@ class MSEDistribution:
 
     def log_prob(self, value: jax.Array) -> jax.Array:
         assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
-        distance = (self._mode - value) ** 2
+        mode, value = _f32(self._mode), _f32(value)
+        distance = (mode - value) ** 2
         axes = tuple(range(-self._dims, 0))
         loss = jnp.mean(distance, axis=axes) if self._agg == "mean" else jnp.sum(distance, axis=axes)
         return -loss
@@ -344,7 +364,9 @@ class TwoHotEncodingDistribution:
         transfwd: Callable[[jax.Array], jax.Array] = symlog,
         transbwd: Callable[[jax.Array], jax.Array] = symexp,
     ):
-        self.logits = logits
+        # value heads read out through this: always fp32 (two-hot bucket
+        # interpolation over 255 bins is exactly the kind of math bf16 ruins)
+        self.logits = _f32(logits)
         self.dims = dims
         self.low = low
         self.high = high

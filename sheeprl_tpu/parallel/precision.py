@@ -1,0 +1,64 @@
+"""Mixed-precision policy for TPU training.
+
+The reference delegates precision to Lightning Fabric's plugin
+(``fabric.precision`` = "32-true" | "bf16-mixed" | "bf16-true" | ...,
+reference sheeprl/cli.py:160-199 passes it straight to ``Fabric``).  On TPU
+bf16 is the native matmul dtype (~2x MXU throughput vs fp32), so the policy
+here is JMP-style and needs no module threading:
+
+- ``bf16-mixed``: params live in fp32 (master weights); inside each loss the
+  params **and** batch are cast to bf16, flax modules (``dtype=None``) promote
+  to bf16 compute, and the gradient of the cast flows back to fp32 params.
+  Optimizer state stays fp32.
+- ``bf16-true``: params themselves are cast to bf16 once after init; the
+  loss-side cast is then a no-op and optimizer state is bf16 too.
+- numerics-sensitive math (distribution log-probs, two-hot, lambda targets,
+  quantile moments) always runs in fp32: every distribution in
+  ``sheeprl_tpu.ops.distributions`` upcasts its parameters at construction,
+  so network outputs re-enter fp32 exactly at the loss boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# precision name -> (param_dtype, compute_dtype)
+PRECISION_DTYPES = {
+    "32-true": (jnp.float32, jnp.float32),
+    "16-mixed": (jnp.float32, jnp.bfloat16),  # fp16 has no TPU advantage; bf16 is native
+    "bf16-mixed": (jnp.float32, jnp.bfloat16),
+    "bf16-true": (jnp.bfloat16, jnp.bfloat16),
+    "64-true": (jnp.float64, jnp.float64),
+}
+
+
+def resolve_precision(precision: str) -> Tuple[Any, Any]:
+    """``precision`` name -> ``(param_dtype, compute_dtype)``."""
+    if precision not in PRECISION_DTYPES:
+        raise ValueError(f"Unknown precision '{precision}'; valid: {list(PRECISION_DTYPES)}")
+    return PRECISION_DTYPES[precision]
+
+
+def compute_dtype_of(cfg) -> Any:
+    """The compute dtype implied by ``cfg.fabric.precision`` (fp32 default)."""
+    fabric = cfg.get("fabric") if hasattr(cfg, "get") else None
+    precision = (fabric or {}).get("precision", "32-true") if fabric else "32-true"
+    return resolve_precision(precision)[1]
+
+
+def cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast every floating leaf of ``tree`` to ``dtype``; other leaves pass
+    through.  Differentiable: the VJP of ``astype`` casts the cotangent back,
+    so fp32 master params receive fp32 gradients through a bf16 cast."""
+    if dtype == jnp.float32:
+        return tree
+
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)

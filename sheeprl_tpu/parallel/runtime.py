@@ -31,15 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.parallel.mesh import make_mesh
-
-_PRECISION_TO_DTYPES = {
-    # precision -> (param_dtype, compute_dtype)
-    "32-true": (jnp.float32, jnp.float32),
-    "16-mixed": (jnp.float32, jnp.bfloat16),  # fp16 has no TPU advantage; bf16 is native
-    "bf16-mixed": (jnp.float32, jnp.bfloat16),
-    "bf16-true": (jnp.bfloat16, jnp.bfloat16),
-    "64-true": (jnp.float64, jnp.float64),
-}
+from sheeprl_tpu.parallel.precision import PRECISION_DTYPES as _PRECISION_TO_DTYPES
+from sheeprl_tpu.parallel.precision import cast_floating
 
 
 class Runtime:
@@ -122,12 +115,7 @@ class Runtime:
     # -- precision --------------------------------------------------------
     def cast(self, tree: Any) -> Any:
         """Cast floating leaves to the compute dtype."""
-        def _cast(x):
-            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
-                return x.astype(self.compute_dtype)
-            return x
-
-        return jax.tree_util.tree_map(_cast, tree)
+        return cast_floating(tree, self.compute_dtype)
 
     # -- host collectives (Fabric API surface) -----------------------------
     def all_gather(self, tree: Any) -> Any:
