@@ -83,3 +83,23 @@ def test_optim_group_instantiation(tmp_path):
     import jax.numpy as jnp
 
     assert runtime.param_dtype == jnp.float32
+
+
+def test_every_exp_config_composes():
+    """Every shipped exp overlay must compose end-to-end (config-tree breadth
+    parity with reference configs/exp/ — 45 overlays)."""
+    import pathlib
+
+    import sheeprl_tpu
+
+    exp_dir = pathlib.Path(sheeprl_tpu.__file__).parent / "configs" / "exp"
+    names = sorted(p.stem for p in exp_dir.glob("*.yaml"))
+    assert len(names) >= 45, names
+    for name in names:
+        if name == "default":
+            continue  # flag-only overlay, not a standalone experiment
+        overrides = [f"exp={name}"]
+        if "fntn" in name or "finetuning" in name:
+            overrides.append("checkpoint.exploration_ckpt_path=/tmp/does_not_matter.ckpt")
+        cfg = compose(overrides, check_missing=False)
+        assert cfg.algo.name, name
