@@ -126,42 +126,55 @@ class ReplayBuffer:
         self._rng = np.random.default_rng(seed)
 
     # -- write path ---------------------------------------------------------
+    def _allocate(self, key: str, per_step_shape: tuple, dtype: Any) -> None:
+        full_shape = (self._buffer_size, self._n_envs, *per_step_shape)
+        if self._memmap:
+            self._buf[key] = MemmapArray(
+                shape=full_shape,
+                dtype=dtype,
+                mode=self._memmap_mode,
+                filename=Path(self._memmap_dir) / f"{key}.memmap",
+            )
+        else:
+            self._buf[key] = np.empty(shape=full_shape, dtype=dtype)
+
     def add(self, data: "ReplayBuffer" | Dict[str, np.ndarray], validate_args: bool = False) -> None:
-        """Insert ``[T, n_envs, ...]`` data at the write head with wrap-around
-        (reference buffers.py:138-221)."""
+        """Insert ``[T, n_envs, ...]`` rows at the write head (behavioral
+        parity with reference buffers.py:138-221).
+
+        The circular write is two contiguous slice assignments: the span from
+        the head to the end of storage, then the wrapped remainder from slot 0.
+        An add longer than the whole buffer keeps only its most recent
+        ``buffer_size`` rows (the older ones would be overwritten within the
+        same call anyway).
+        """
         if isinstance(data, ReplayBuffer):
             data = data.buffer
         if validate_args:
             _validate_add_data(data)
-        data_len = next(iter(data.values())).shape[0]
-        next_pos = (self._pos + data_len) % self._buffer_size
-        if next_pos <= self._pos or (data_len > self._buffer_size and not self._full):
-            idxes = np.array(list(range(self._pos, self._buffer_size)) + list(range(0, next_pos)))
-        else:
-            idxes = np.arange(self._pos, next_pos)
-        if data_len > self._buffer_size:
-            data_to_store = {k: v[-self._buffer_size - next_pos :] for k, v in data.items()}
-        else:
-            data_to_store = data
-        if self.empty:
-            for k, v in data_to_store.items():
-                full_shape = (self._buffer_size, self._n_envs, *v.shape[2:])
-                if self._memmap:
-                    self._buf[k] = MemmapArray(
-                        shape=full_shape,
-                        dtype=v.dtype,
-                        mode=self._memmap_mode,
-                        filename=Path(self._memmap_dir) / f"{k}.memmap",
+        steps = next(iter(data.values())).shape[0]
+        if steps > self._buffer_size:
+            data = {k: v[steps - self._buffer_size :] for k, v in data.items()}
+            steps = self._buffer_size
+        head = self._pos
+        tail_span = min(steps, self._buffer_size - head)
+        was_empty = self.empty
+        for k, v in data.items():
+            if k not in self._buf:
+                if not was_empty:
+                    # a key appearing after the first add would leave every
+                    # earlier row uninitialized — fail loudly instead
+                    raise KeyError(
+                        f"Unknown buffer key '{k}'; the buffer was initialized with {sorted(self._buf)}"
                     )
-                else:
-                    self._buf[k] = np.empty(shape=full_shape, dtype=v.dtype)
-                self._buf[k][idxes] = v
-        else:
-            for k, v in data_to_store.items():
-                self._buf[k][idxes] = v
-        if self._pos + data_len >= self._buffer_size:
+                self._allocate(k, v.shape[2:], v.dtype)
+            storage = self._buf[k]
+            storage[head : head + tail_span] = v[:tail_span]
+            if steps > tail_span:  # wrapped remainder
+                storage[: steps - tail_span] = v[tail_span:]
+        if head + steps >= self._buffer_size:
             self._full = True
-        self._pos = next_pos
+        self._pos = (head + steps) % self._buffer_size
 
     # -- read path ----------------------------------------------------------
     def sample(
@@ -178,20 +191,23 @@ class ReplayBuffer:
             raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
         if not self._full and self._pos == 0:
             raise ValueError("No sample has been added to the buffer. Call 'add' first")
+        draw = batch_size * n_samples
         if self._full:
-            first_range_end = self._pos - 1 if sample_next_obs else self._pos
-            second_range_end = self._buffer_size if first_range_end >= 0 else self._buffer_size + first_range_end
-            valid_idxes = np.array(
-                list(range(0, first_range_end)) + list(range(self._pos, second_range_end)), dtype=np.intp
-            )
-            batch_idxes = valid_idxes[self._rng.integers(0, len(valid_idxes), size=(batch_size * n_samples,))]
+            if sample_next_obs:
+                # every slot but the newest is valid (the newest slot's
+                # successor is the oldest entry — a data discontinuity);
+                # draw an *age* in [1, size) and map it back to a slot
+                ages = self._rng.integers(1, self._buffer_size, size=(draw,), dtype=np.intp)
+                batch_idxes = (self._pos - 1 - ages) % self._buffer_size
+            else:
+                batch_idxes = self._rng.integers(0, self._buffer_size, size=(draw,), dtype=np.intp)
         else:
-            max_pos_to_sample = self._pos - 1 if sample_next_obs else self._pos
-            if max_pos_to_sample == 0:
+            stored = self._pos - 1 if sample_next_obs else self._pos
+            if stored == 0:
                 raise RuntimeError(
                     "Cannot sample next observations with a single stored step; add at least two steps"
                 )
-            batch_idxes = self._rng.integers(0, max_pos_to_sample, size=(batch_size * n_samples,), dtype=np.intp)
+            batch_idxes = self._rng.integers(0, stored, size=(draw,), dtype=np.intp)
         samples = self._get_samples(batch_idxes, sample_next_obs=sample_next_obs, clone=clone)
         return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in samples.items()}
 
@@ -303,17 +319,18 @@ class SequentialReplayBuffer(ReplayBuffer):
                 f"The sequence length ({sequence_length}) is greater than the buffer size ({self._buffer_size})"
             )
         if self._full:
-            # valid starts exclude the window that would span the write head
-            first_range_end = self._pos - sequence_length + 1
-            second_range_end = self._buffer_size if first_range_end >= 0 else self._buffer_size + first_range_end
-            valid_idxes = np.array(
-                list(range(0, first_range_end)) + list(range(self._pos, second_range_end)), dtype=np.intp
+            # a window is valid iff it lies inside the logical stream (it may
+            # wrap physically, never across the write head).  In age space
+            # (newest stored row = age 0) the window's *start* age ranges over
+            # [sequence_length - 1, size) — draw there and map back to slots.
+            start_ages = self._rng.integers(
+                sequence_length - 1, self._buffer_size, size=(batch_dim,), dtype=np.intp
             )
-            start_idxes = valid_idxes[self._rng.integers(0, len(valid_idxes), size=(batch_dim,))]
+            start_idxes = (self._pos - 1 - start_ages) % self._buffer_size
         else:
             start_idxes = self._rng.integers(0, self._pos - sequence_length + 1, size=(batch_dim,), dtype=np.intp)
-        chunk = np.arange(sequence_length, dtype=np.intp).reshape(1, -1)
-        idxes = (start_idxes.reshape(-1, 1) + chunk) % self._buffer_size
+        offsets = np.arange(sequence_length, dtype=np.intp)[None, :]
+        idxes = (start_idxes[:, None] + offsets) % self._buffer_size
         return self._get_seq_samples(idxes, batch_size, n_samples, sequence_length, sample_next_obs, clone)
 
     def _get_seq_samples(

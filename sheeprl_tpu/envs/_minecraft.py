@@ -15,13 +15,15 @@ from typing import Dict, Tuple
 
 import numpy as np
 
-__all__ = ["StickyActions", "PitchTracker", "count_items"]
+__all__ = ["StickyActions", "MineDojoSticky", "PitchTracker", "count_items"]
 
 
 @dataclass
 class StickyActions:
-    """Repeat `attack`/`jump` for a configurable number of steps after they
-    were last selected (Hafner's Minecraft trick; reference minerl.py:238-252).
+    """MineRL-style sticky attack/jump: repeat for a configurable number of
+    steps after last selected, unconditionally (Hafner's Minecraft trick;
+    reference minerl.py:238-252).  A sticky attack suppresses jumping; the
+    MineRL adapter additionally presses `forward` while a jump is sticky.
 
     `attack_for`/`jump_for` of 0 disables the respective stickiness.  The
     caller asks `update(attack=..., jump=...)` each step with the *selected*
@@ -33,15 +35,10 @@ class StickyActions:
     _attack_left: int = field(default=0, init=False)
     _jump_left: int = field(default=0, init=False)
 
-    def update(self, attack: bool, jump: bool, cancel_attack: bool = False) -> Tuple[bool, bool]:
-        """`cancel_attack=True` means the agent picked a *different* functional
-        action this step, which interrupts a pending sticky attack (MineDojo
-        semantics, reference minedojo.py:196-198)."""
+    def update(self, attack: bool, jump: bool) -> Tuple[bool, bool]:
         if self.attack_for:
             if attack:
                 self._attack_left = self.attack_for
-            elif cancel_attack:
-                self._attack_left = 0
             if self._attack_left > 0:
                 attack = True
                 jump = False
@@ -53,6 +50,55 @@ class StickyActions:
                 jump = True
                 self._jump_left -= 1
         return attack, jump
+
+    def reset(self) -> None:
+        self._attack_left = 0
+        self._jump_left = 0
+
+
+@dataclass
+class MineDojoSticky:
+    """MineDojo-style *cancelable* sticky attack/jump, operating on the
+    converted 8-slot MineDojo action vector (reference minedojo.py:184-215).
+
+    Differences from the MineRL machine, preserved exactly:
+    - selecting attack arms ``attack_for - 1`` *extra* repeats (the selection
+      step itself is not counted down);
+    - a pending sticky attack only fires on functional no-ops and is canceled
+      by any other functional action; it does NOT suppress jumping;
+    - a pending sticky jump only fires when no forward/backward was selected
+      (pressing forward too when the agent is otherwise still) and is canceled
+      when the agent picks sneak/sprint instead of jump.
+
+    Vector slots: 0 forward/backward, 1 left/right, 2 jump/sneak/sprint
+    (1 = jump), 5 functional (3 = attack).
+    """
+
+    attack_for: int = 30
+    jump_for: int = 10
+    _attack_left: int = field(default=0, init=False)
+    _jump_left: int = field(default=0, init=False)
+
+    def apply(self, vec: np.ndarray) -> np.ndarray:
+        if self.attack_for:
+            if vec[5] == 3:
+                self._attack_left = self.attack_for - 1
+            if self._attack_left > 0 and vec[5] == 0:
+                vec[5] = 3
+                self._attack_left -= 1
+            elif vec[5] != 3:
+                self._attack_left = 0
+        if self.jump_for:
+            if vec[2] == 1:
+                self._jump_left = self.jump_for - 1
+            if self._jump_left > 0 and vec[0] == 0:
+                vec[2] = 1
+                if vec[0] == 0 and vec[1] == 0:
+                    vec[0] = 1
+                self._jump_left -= 1
+            elif vec[2] != 1:
+                self._jump_left = 0
+        return vec
 
     def reset(self) -> None:
         self._attack_left = 0

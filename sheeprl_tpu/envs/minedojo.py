@@ -20,7 +20,7 @@ import gymnasium as gym
 import numpy as np
 from gymnasium import spaces
 
-from sheeprl_tpu.envs._minecraft import PitchTracker, StickyActions, count_items
+from sheeprl_tpu.envs._minecraft import MineDojoSticky, PitchTracker, count_items
 from sheeprl_tpu.utils.imports import _IS_MINEDOJO_AVAILABLE
 
 if not _IS_MINEDOJO_AVAILABLE:
@@ -94,7 +94,7 @@ class MineDojoWrapper(gym.Env):
             )
         # a >1 break-speed multiplier already shortens digging; stickiness on
         # top of it would overshoot (reference minedojo.py:74)
-        self._sticky = StickyActions(
+        self._sticky = MineDojoSticky(
             attack_for=0 if break_speed > 1 else sticky_attack, jump_for=sticky_jump
         )
         self._pitch = PitchTracker(limits=(float(pitch_limits[0]), float(pitch_limits[1])))
@@ -202,17 +202,7 @@ class MineDojoWrapper(gym.Env):
 
     def _convert_action(self, action: np.ndarray) -> np.ndarray:
         arnn = ACTION_MAP[int(action[0])].copy()
-        attack, jump = self._sticky.update(
-            attack=arnn[5] == _FN_ATTACK,
-            jump=arnn[2] == 1,
-            cancel_attack=arnn[5] not in (0, _FN_ATTACK),
-        )
-        if attack and arnn[5] == 0:
-            arnn[5] = _FN_ATTACK
-        if jump and arnn[2] != 1:
-            arnn[2] = 1
-            if arnn[0] == arnn[1] == 0:  # jump implies forward unless already moving
-                arnn[0] = 1
+        arnn = self._sticky.apply(arnn)
         arnn[6] = int(action[1]) if arnn[5] == _FN_CRAFT else 0
         # equip/place/destroy take the *slot* of the chosen item id
         if arnn[5] in _FN_WITH_ITEM_ARG:

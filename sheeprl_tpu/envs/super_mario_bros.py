@@ -51,7 +51,10 @@ class SuperMarioBrosWrapper(gym.Env):
         if isinstance(action, np.ndarray):
             action = int(action.squeeze())
         obs, reward, done, info = self._env.step(action)
-        out_of_time = bool(info.get("time", False))
+        # time==0 means the NES clock expired: a truncation, not a real
+        # terminal state.  (The reference wrapper tests `bool(info["time"])`,
+        # super_mario_bros.py:58, which inverts this — deliberate fix.)
+        out_of_time = info.get("time", 1) == 0
         return {"rgb": obs.copy()}, float(reward), done and not out_of_time, done and out_of_time, info
 
     def reset(

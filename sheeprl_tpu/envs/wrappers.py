@@ -127,53 +127,70 @@ class RestartOnException(gym.Wrapper):
 
 
 class FrameStack(gym.Wrapper):
-    """Stack the last ``num_stack`` (dilated) pixel frames per cnn key along a
-    new leading axis (reference wrappers.py:128-183)."""
+    """Expose a rolling window over each pixel key: the observation becomes
+    ``[num_stack, ...]`` holding every ``dilation``-th of the most recent
+    ``num_stack * dilation`` frames, newest last (behavioral parity with
+    reference wrappers.py:128-183).
+
+    Each tracked key owns a preallocated ring buffer; a step costs one copy of
+    the newest frame plus one modular gather — no per-step deque churn or
+    re-stacking of the whole window.
+    """
 
     def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1) -> None:
         super().__init__(env)
         if num_stack <= 0:
-            raise ValueError(f"Invalid value for num_stack, expected a value greater than zero, got {num_stack}")
+            raise ValueError(f"num_stack must be a positive integer, got {num_stack}")
+        if dilation <= 0:
+            raise ValueError(f"dilation must be a positive integer, got {dilation}")
         if not isinstance(env.observation_space, gym.spaces.Dict):
             raise RuntimeError(
-                f"Expected an observation space of type gym.spaces.Dict, got: {type(env.observation_space)}"
+                f"FrameStack needs a gym.spaces.Dict observation space, got {type(env.observation_space)}"
             )
         self._num_stack = num_stack
         self._dilation = dilation
-        self._cnn_keys = []
+        self._window = num_stack * dilation
+        wanted = set(cnn_keys or ())
+        tracked = [
+            k for k, space in env.observation_space.spaces.items() if k in wanted and len(space.shape) == 3
+        ]
+        if not tracked:
+            raise RuntimeError(f"None of the cnn keys {sorted(wanted)} name a 3-D observation to stack")
         self.observation_space = copy.deepcopy(env.observation_space)
-        for k, v in env.observation_space.spaces.items():
-            if cnn_keys and k in cnn_keys and len(v.shape) == 3:
-                self._cnn_keys.append(k)
-                self.observation_space[k] = gym.spaces.Box(
-                    np.repeat(v.low[None, ...], num_stack, axis=0),
-                    np.repeat(v.high[None, ...], num_stack, axis=0),
-                    (num_stack, *v.shape),
-                    v.dtype,
-                )
-        if len(self._cnn_keys) == 0:
-            raise RuntimeError("Specify at least one valid cnn key to be stacked")
-        self._frames = {k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys}
+        self._ring: Dict[str, np.ndarray] = {}
+        for k in tracked:
+            space = env.observation_space[k]
+            self.observation_space[k] = gym.spaces.Box(
+                np.broadcast_to(space.low, (num_stack, *space.shape)).copy(),
+                np.broadcast_to(space.high, (num_stack, *space.shape)).copy(),
+                (num_stack, *space.shape),
+                space.dtype,
+            )
+            self._ring[k] = np.zeros((self._window, *space.shape), dtype=space.dtype)
+        self._frames_seen = 0
 
-    def _get_obs(self, key: str) -> np.ndarray:
-        frames_subset = list(self._frames[key])[self._dilation - 1 :: self._dilation]
-        assert len(frames_subset) == self._num_stack
-        return np.stack(frames_subset, axis=0)
+    def _stacked(self, key: str) -> np.ndarray:
+        # ages (newest = 0) of the exposed frames are 0, d, ..., (S-1)*d;
+        # the frame with age a lives in slot (frames_seen - 1 - a) % window
+        newest = self._frames_seen - 1
+        slots = (newest - self._dilation * np.arange(self._num_stack - 1, -1, -1)) % self._window
+        return self._ring[key][slots]
 
     def step(self, action):
         obs, reward, done, truncated, infos = self.env.step(action)
-        for k in self._cnn_keys:
-            self._frames[k].append(obs[k])
-            obs[k] = self._get_obs(k)
+        slot = self._frames_seen % self._window
+        self._frames_seen += 1
+        for k, ring in self._ring.items():
+            ring[slot] = obs[k]
+            obs[k] = self._stacked(k)
         return obs, reward, done, truncated, infos
 
     def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None, **kwargs):
         obs, infos = self.env.reset(seed=seed, **kwargs)
-        for k in self._cnn_keys:
-            self._frames[k].clear()
-            for _ in range(self._num_stack * self._dilation):
-                self._frames[k].append(obs[k])
-            obs[k] = self._get_obs(k)
+        self._frames_seen = self._window
+        for k, ring in self._ring.items():
+            ring[:] = obs[k][None]
+            obs[k] = self._stacked(k)
         return obs, infos
 
 

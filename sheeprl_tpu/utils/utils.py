@@ -57,11 +57,18 @@ def polynomial_decay(
 
 
 class Ratio:
-    """Replay-ratio scheduler: how many gradient steps to run per new policy
-    steps (reference: utils/utils.py:262-300, itself after Hafner's DreamerV3).
+    """Gradient-step budgeter: decides how many optimizer steps the trainer
+    owes the policy-step counter at a given replay ratio (behavioural parity
+    with reference utils/utils.py:262-300; re-derived as a credit accumulator).
 
-    Stateful on purpose: it lives on the host next to the training loop and is
-    checkpointed via ``state_dict``.
+    Every call banks ``(step - last_step) * ratio`` of fractional gradient-step
+    credit and pays out its integer part, carrying the remainder — so over a
+    run exactly ``ratio`` gradient steps happen per policy step, regardless of
+    call granularity.  The first call pays a pretrain burst of
+    ``pretrain_steps * ratio`` instead (clamped to the steps actually taken).
+
+    Lives on the host next to the training loop; checkpointed via
+    ``state_dict``.
     """
 
     def __init__(self, ratio: float, pretrain_steps: int = 0):
@@ -69,37 +76,47 @@ class Ratio:
             raise ValueError(f"'pretrain_steps' must be non-negative, got {pretrain_steps}")
         if ratio < 0:
             raise ValueError(f"'ratio' must be non-negative, got {ratio}")
-        self._pretrain_steps = pretrain_steps
-        self._ratio = ratio
-        self._prev: float | None = None
+        self._ratio = float(ratio)
+        self._pretrain_steps = int(pretrain_steps)
+        self._last_step: float | None = None
+        self._credit = 0.0
 
     def __call__(self, step: int) -> int:
         if self._ratio == 0:
             return 0
-        if self._prev is None:
-            self._prev = step
-            repeats = int(step * self._ratio)
-            if self._pretrain_steps > 0:
-                if step < self._pretrain_steps:
-                    warnings.warn(
-                        "The number of pretrain steps is greater than the number of current steps. "
-                        f"This could lead to a higher ratio than the one specified ({self._ratio}). "
-                        "Setting the 'pretrain_steps' equal to the number of current steps."
-                    )
-                    self._pretrain_steps = step
-                repeats = int(self._pretrain_steps * self._ratio)
-            return repeats
-        repeats = int((step - self._prev) * self._ratio)
-        self._prev += repeats / self._ratio
+        if self._last_step is None:
+            self._last_step = step
+            burst = self._pretrain_steps
+            if burst > 0 and step < burst:
+                warnings.warn(
+                    f"pretrain_steps ({burst}) exceeds the policy steps taken so far ({step}); "
+                    f"clamping the pretrain burst to {step} steps to keep the effective "
+                    f"replay ratio at {self._ratio}."
+                )
+                self._pretrain_steps = burst = step
+            return int((burst if burst > 0 else step) * self._ratio)
+        self._credit += (step - self._last_step) * self._ratio
+        self._last_step = step
+        repeats = int(self._credit)
+        self._credit -= repeats
         return repeats
 
     def state_dict(self) -> Dict[str, Any]:
-        return {"_ratio": self._ratio, "_prev": self._prev, "_pretrain_steps": self._pretrain_steps}
+        return {
+            "ratio": self._ratio,
+            "last_step": self._last_step,
+            "credit": self._credit,
+            "pretrain_steps": self._pretrain_steps,
+        }
 
     def load_state_dict(self, state_dict: Mapping[str, Any]) -> "Ratio":
-        self._ratio = state_dict["_ratio"]
-        self._prev = state_dict["_prev"]
-        self._pretrain_steps = state_dict["_pretrain_steps"]
+        # also accept the pre-rewrite key names so old checkpoints resume
+        self._ratio = state_dict.get("ratio", state_dict.get("_ratio"))
+        self._last_step = state_dict.get("last_step", state_dict.get("_prev"))
+        self._credit = state_dict.get("credit", 0.0)
+        self._pretrain_steps = state_dict.get("pretrain_steps", state_dict.get("_pretrain_steps", 0))
+        if self._ratio is None:
+            raise KeyError(f"Unrecognized Ratio state: {sorted(state_dict)}")
         return self
 
 

@@ -1,0 +1,239 @@
+"""Edge-case buffer tests — depth parity with the reference suite
+(reference tests/test_data/test_buffers.py:1-449, test_episode_buffer.py:1-443):
+wrap-around content, head-window validity of sequence sampling, oversized adds,
+memmap persistence/eviction, prioritize_ends, EnvIndependent index routing.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+
+
+def _stream(t0: int, steps: int, n_envs: int = 1) -> dict:
+    """obs[t] == t for a global step counter — makes content checks exact."""
+    obs = (np.arange(t0, t0 + steps, dtype=np.float32).reshape(-1, 1, 1) + np.zeros((1, n_envs, 1)))
+    return {"observations": obs}
+
+
+class TestReplayBufferWrapAround:
+    def test_content_after_many_wraps(self):
+        rb = ReplayBuffer(8, n_envs=1)
+        t = 0
+        for chunk in (3, 5, 7, 2, 6):
+            rb.add(_stream(t, chunk))
+            t += chunk
+        # buffer must hold exactly the last 8 global steps, slot (t-age-1) % 8
+        stored = np.asarray(rb["observations"])[:, 0, 0]
+        for age in range(8):
+            step = t - 1 - age
+            assert stored[step % 8] == step
+
+    def test_oversized_add_keeps_most_recent_rows(self):
+        rb = ReplayBuffer(4, n_envs=1)
+        rb.add(_stream(0, 11))
+        assert rb.full
+        stored = sorted(np.asarray(rb["observations"])[:, 0, 0].tolist())
+        assert stored == [7.0, 8.0, 9.0, 10.0]
+
+    def test_exact_fit_add_marks_full(self):
+        rb = ReplayBuffer(6, n_envs=1)
+        rb.add(_stream(0, 6))
+        assert rb.full and rb._pos == 0
+
+    def test_sample_next_obs_is_successor(self):
+        rb = ReplayBuffer(8, n_envs=1, obs_keys=("observations",))
+        rb.add(_stream(0, 13))  # full + wrapped
+        s = rb.sample(256, sample_next_obs=True)
+        np.testing.assert_allclose(
+            s["next_observations"][..., 0], s["observations"][..., 0] + 1
+        )
+
+    def test_full_plain_sample_covers_all_slots(self):
+        rb = ReplayBuffer(8, n_envs=1)
+        rb.add(_stream(0, 8))
+        s = rb.sample(4096)
+        seen = set(np.unique(s["observations"]))
+        assert seen == set(float(x) for x in range(8))
+
+
+class TestSequentialWindows:
+    @pytest.mark.parametrize("wraps", [1, 3])
+    def test_sequences_never_span_the_write_head(self, wraps):
+        size, L = 16, 5
+        rb = SequentialReplayBuffer(size, n_envs=1)
+        total = size * wraps + 7
+        rb.add(_stream(0, total))
+        s = rb.sample(512, sequence_length=L)  # [1, L, 512, 1]
+        seqs = s["observations"][0, :, :, 0]  # [L, 512]
+        diffs = np.diff(seqs, axis=0)
+        # contiguity in the *logical stream*: every window strictly +1 steps
+        np.testing.assert_allclose(diffs, 1.0)
+        # and every window lies inside the last `size` steps
+        assert seqs.min() >= total - size
+        assert seqs.max() <= total - 1
+
+    def test_all_valid_starts_reachable_when_full(self):
+        size, L = 8, 3
+        rb = SequentialReplayBuffer(size, n_envs=1)
+        total = 19
+        rb.add(_stream(0, total))
+        s = rb.sample(4096, sequence_length=L)
+        starts = set(np.unique(s["observations"][0, 0, :, 0]))
+        # valid start steps: the last size-L+1 steps that fit a full window
+        expected = set(float(x) for x in range(total - size, total - L + 1))
+        assert starts == expected
+
+    def test_not_full_rejects_too_long_sequence(self):
+        rb = SequentialReplayBuffer(16, n_envs=1)
+        rb.add(_stream(0, 4))
+        with pytest.raises(ValueError, match="Cannot sample a sequence"):
+            rb.sample(1, sequence_length=5)
+
+    def test_full_rejects_longer_than_buffer(self):
+        rb = SequentialReplayBuffer(8, n_envs=1)
+        rb.add(_stream(0, 9))
+        with pytest.raises(ValueError, match="greater than the buffer size"):
+            rb.sample(1, sequence_length=9)
+
+
+class TestMemmapPersistence:
+    def test_wraparound_through_memmap(self, tmp_path):
+        rb = ReplayBuffer(6, n_envs=1, memmap=True, memmap_dir=str(tmp_path / "rb"))
+        rb.add(_stream(0, 4))
+        rb.add(_stream(4, 5))
+        assert rb.is_memmap
+        stored = sorted(np.asarray(rb["observations"])[:, 0, 0].tolist())
+        assert stored == [3.0, 4.0, 5.0, 6.0, 7.0, 8.0]
+        assert os.path.exists(tmp_path / "rb" / "observations.memmap")
+
+    def test_state_dict_roundtrip_preserves_wrap(self, tmp_path):
+        rb = ReplayBuffer(4, n_envs=1, memmap=True, memmap_dir=str(tmp_path / "a"))
+        rb.add(_stream(0, 6))
+        rb2 = ReplayBuffer(4, n_envs=1, memmap=True, memmap_dir=str(tmp_path / "b"))
+        rb2.load_state_dict(rb.state_dict())
+        np.testing.assert_allclose(
+            np.asarray(rb["observations"]), np.asarray(rb2["observations"])
+        )
+        assert rb2._pos == rb._pos and rb2.full == rb.full
+
+
+class TestEnvIndependentRouting:
+    def test_partial_indices_route_to_right_subbuffer(self):
+        rb = EnvIndependentReplayBuffer(8, n_envs=3, buffer_cls=SequentialReplayBuffer)
+        data = {"observations": np.full((2, 3, 1), 7.0, np.float32)}
+        rb.add(data)  # all envs
+        reset = {"observations": np.full((2, 1, 1), 9.0, np.float32)}
+        rb.add(reset, indices=[1])  # env 1 only
+        assert rb.buffer[0]._pos == 2
+        assert rb.buffer[1]._pos == 4
+        assert rb.buffer[2]._pos == 2
+        assert np.asarray(rb.buffer[1]["observations"])[2:4].flatten().tolist() == [9.0, 9.0]
+
+    def test_sample_concatenates_on_buffer_cls_axis(self):
+        rb = EnvIndependentReplayBuffer(8, n_envs=2, buffer_cls=SequentialReplayBuffer)
+        rb.add({"observations": np.zeros((6, 2, 1), np.float32)})
+        s = rb.sample(10, sequence_length=3, n_samples=2)
+        assert s["observations"].shape == (2, 3, 10, 1)
+
+
+def _episode(t0: int, length: int, n_envs: int = 1, end: str = "terminated") -> dict:
+    data = _stream(t0, length, n_envs)
+    data["terminated"] = np.zeros((length, n_envs, 1), np.float32)
+    data["truncated"] = np.zeros((length, n_envs, 1), np.float32)
+    data[end][-1] = 1.0
+    return data
+
+
+class TestEpisodeBufferEdges:
+    def test_eviction_keeps_total_under_capacity(self):
+        eb = EpisodeBuffer(20, minimum_episode_length=2, n_envs=1)
+        for i in range(6):
+            eb.add(_episode(10 * i, 6))
+        assert len(eb) <= 20
+        # oldest episodes evicted, newest retained
+        first_stored = np.asarray(eb.buffer[0]["observations"]).flatten()[0]
+        assert first_stored >= 20.0
+
+    def test_memmap_eviction_removes_episode_dirs(self, tmp_path):
+        eb = EpisodeBuffer(
+            12, minimum_episode_length=2, n_envs=1, memmap=True, memmap_dir=str(tmp_path)
+        )
+        for i in range(5):
+            eb.add(_episode(10 * i, 5))
+        remaining_dirs = [d for d in os.listdir(tmp_path) if d.startswith("episode_")]
+        assert len(remaining_dirs) == len(eb.buffer)
+        assert len(eb) <= 12
+
+    def test_truncated_counts_as_episode_end(self):
+        eb = EpisodeBuffer(32, minimum_episode_length=2, n_envs=1)
+        eb.add(_episode(0, 4, end="truncated"))
+        assert len(eb.buffer) == 1
+
+    def test_open_episode_across_adds(self):
+        eb = EpisodeBuffer(32, minimum_episode_length=2, n_envs=1)
+        part1 = _episode(0, 3)
+        part1["terminated"][-1] = 0.0  # no end yet
+        eb.add(part1)
+        assert len(eb.buffer) == 0 and len(eb._open_episodes[0]) == 1
+        eb.add(_episode(3, 2))
+        assert len(eb.buffer) == 1
+        stored = np.asarray(eb.buffer[0]["observations"]).flatten()
+        np.testing.assert_allclose(stored, np.arange(5, dtype=np.float32))
+
+    def test_too_short_episode_rejected(self):
+        eb = EpisodeBuffer(32, minimum_episode_length=4, n_envs=1)
+        with pytest.raises(RuntimeError, match="too short"):
+            eb.add(_episode(0, 2))
+
+    def test_prioritize_ends_biases_final_windows(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=2, n_envs=1, prioritize_ends=True)
+        eb.add(_episode(0, 32))
+        eb.seed(3)
+        L = 8
+        s = eb.sample(2048, sequence_length=L)
+        # the clamped draw makes the final window (start == ep_len - L) the
+        # single most likely start
+        starts = s["observations"][0, 0, :, 0]
+        values, counts = np.unique(starts, return_counts=True)
+        assert values[np.argmax(counts)] == 32 - L
+
+    def test_uniform_sampling_without_prioritize_ends(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=2, n_envs=1, prioritize_ends=False)
+        eb.add(_episode(0, 32))
+        eb.seed(3)
+        L = 8
+        s = eb.sample(4096, sequence_length=L)
+        starts = s["observations"][0, 0, :, 0]
+        values, counts = np.unique(starts, return_counts=True)
+        assert set(values) == set(float(x) for x in range(32 - L + 1))
+        # roughly uniform: no start more than 2.5x the expected share
+        assert counts.max() < 2.5 * 4096 / (32 - L + 1)
+
+    def test_sample_next_obs_within_episode(self):
+        eb = EpisodeBuffer(64, minimum_episode_length=2, n_envs=1)
+        eb.add(_episode(0, 16))
+        s = eb.sample(128, sequence_length=4, sample_next_obs=True)
+        np.testing.assert_allclose(
+            s["next_observations"][..., 0], s["observations"][..., 0] + 1
+        )
+
+    def test_state_dict_preserves_open_episodes(self):
+        eb = EpisodeBuffer(32, minimum_episode_length=2, n_envs=2)
+        part = _episode(0, 3, n_envs=2)
+        part["terminated"][-1] = 0.0
+        eb.add(part)
+        state = eb.state_dict()
+        eb2 = EpisodeBuffer(32, minimum_episode_length=2, n_envs=2).load_state_dict(state)
+        assert len(eb2._open_episodes[0]) == 1
+        eb2.add(_episode(3, 2, n_envs=2))
+        assert len(eb2.buffer) == 2  # one closed episode per env

@@ -60,14 +60,6 @@ class TestStickyActions:
         assert sticky.update(attack=False, jump=False) == (False, True)
         assert sticky.update(attack=False, jump=False) == (False, False)
 
-    def test_cancel_attack(self):
-        """MineDojo semantics: choosing another functional action interrupts
-        a pending sticky attack (reference minedojo.py:196-198)."""
-        sticky = StickyActions(attack_for=5, jump_for=0)
-        sticky.update(attack=True, jump=False)
-        assert sticky.update(attack=False, jump=False, cancel_attack=True) == (False, False)
-        assert sticky.update(attack=False, jump=False) == (False, False)
-
     def test_disabled(self):
         sticky = StickyActions(attack_for=0, jump_for=0)
         assert sticky.update(attack=True, jump=True) == (True, True)
@@ -78,6 +70,80 @@ class TestStickyActions:
         sticky.update(attack=True, jump=True)
         sticky.reset()
         assert sticky.update(attack=False, jump=False) == (False, False)
+
+
+class TestMineDojoSticky:
+    """Pin the MineDojo-specific cancelable semantics (reference
+    minedojo.py:184-215): attack arms N-1 extra repeats, only fires on
+    functional no-ops, cancels on other functional actions; jump doesn't get
+    suppressed by sticky attack; sneak/sprint cancels a sticky jump."""
+
+    @staticmethod
+    def _vec(forward=0, lateral=0, jsn=0, fn=0):
+        import numpy as np
+
+        v = np.zeros(8, dtype=np.int64)
+        v[0], v[1], v[2], v[5] = forward, lateral, jsn, fn
+        return v
+
+    def test_attack_repeats_on_noop_and_arms_n_minus_1(self):
+        from sheeprl_tpu.envs._minecraft import MineDojoSticky
+
+        s = MineDojoSticky(attack_for=3, jump_for=0)
+        assert s.apply(self._vec(fn=3))[5] == 3  # selected
+        assert s.apply(self._vec())[5] == 3  # repeat 1
+        assert s.apply(self._vec())[5] == 3  # repeat 2 (= attack_for - 1)
+        assert s.apply(self._vec())[5] == 0
+
+    def test_other_functional_cancels_attack(self):
+        from sheeprl_tpu.envs._minecraft import MineDojoSticky
+
+        s = MineDojoSticky(attack_for=10, jump_for=0)
+        s.apply(self._vec(fn=3))
+        assert s.apply(self._vec(fn=1))[5] == 1  # use: not overridden, cancels
+        assert s.apply(self._vec())[5] == 0
+
+    def test_sticky_attack_does_not_suppress_jump(self):
+        from sheeprl_tpu.envs._minecraft import MineDojoSticky
+
+        s = MineDojoSticky(attack_for=10, jump_for=0)
+        s.apply(self._vec(fn=3))
+        out = s.apply(self._vec(jsn=1))
+        assert out[2] == 1  # jump preserved during the sticky-attack window
+        assert out[5] == 3  # and the attack still repeats (jump is fn no-op)
+
+    def test_sticky_jump_presses_forward_when_still(self):
+        from sheeprl_tpu.envs._minecraft import MineDojoSticky
+
+        s = MineDojoSticky(attack_for=0, jump_for=3)
+        s.apply(self._vec(jsn=1))
+        out = s.apply(self._vec())
+        assert out[2] == 1 and out[0] == 1  # jump repeated, forward pressed
+
+    def test_forward_selection_blocks_jump_repeat(self):
+        from sheeprl_tpu.envs._minecraft import MineDojoSticky
+
+        s = MineDojoSticky(attack_for=0, jump_for=5)
+        s.apply(self._vec(jsn=1))
+        out = s.apply(self._vec(forward=1))
+        assert out[2] == 0  # moving forward: no forced jump, stickiness canceled
+        assert s.apply(self._vec())[2] == 0
+
+    def test_sneak_while_stationary_is_overridden_but_moving_sneak_cancels(self):
+        from sheeprl_tpu.envs._minecraft import MineDojoSticky
+
+        # stationary sneak: the reference's repeat branch fires first
+        # (conv[0]==0), overriding sneak with jump+forward
+        s = MineDojoSticky(attack_for=0, jump_for=5)
+        s.apply(self._vec(jsn=1))
+        out = s.apply(self._vec(jsn=2))  # sneak, not moving
+        assert out[2] == 1 and out[0] == 1
+        # sneak while moving forward: repeat blocked -> cancel branch runs
+        s2 = MineDojoSticky(attack_for=0, jump_for=5)
+        s2.apply(self._vec(jsn=1))
+        out2 = s2.apply(self._vec(forward=1, jsn=2))
+        assert out2[2] == 2  # sneak preserved
+        assert s2.apply(self._vec())[2] == 0  # stickiness gone
 
 
 class TestPitchTracker:

@@ -162,3 +162,48 @@ def test_vectorized_env_sync():
     assert obs["rgb"].shape == (2, 3, 64, 64)
     obs, *_ = envs.step(envs.action_space.sample())
     assert obs["state"].shape == (2, 10)
+
+
+@pytest.mark.parametrize("num_stack,dilation", [(1, 1), (3, 1), (2, 2), (3, 4)])
+def test_frame_stack_ring_matches_deque_oracle(num_stack, dilation):
+    """The ring-buffer FrameStack must expose every `dilation`-th of the last
+    `num_stack*dilation` frames, newest last — checked against a straight
+    deque implementation."""
+    from collections import deque
+
+    import gymnasium as gym
+
+    class CountingEnv(gym.Env):
+        observation_space = gym.spaces.Dict(
+            {"rgb": gym.spaces.Box(0, 255, (3, 4, 4), np.uint8)}
+        )
+        action_space = gym.spaces.Discrete(2)
+
+        def __init__(self):
+            self._t = 0
+
+        def _obs(self):
+            return {"rgb": np.full((3, 4, 4), self._t % 256, np.uint8)}
+
+        def reset(self, *, seed=None, options=None):
+            self._t = 0
+            return self._obs(), {}
+
+        def step(self, action):
+            self._t += 1
+            return self._obs(), 0.0, False, False, {}
+
+    env = FrameStack(CountingEnv(), num_stack, ["rgb"], dilation)
+    oracle = deque(maxlen=num_stack * dilation)
+
+    obs, _ = env.reset()
+    oracle.extend([np.full((3, 4, 4), 0, np.uint8)] * (num_stack * dilation))
+    expected = np.stack(list(oracle)[dilation - 1 :: dilation])
+    np.testing.assert_array_equal(obs["rgb"], expected)
+    assert obs["rgb"].shape == (num_stack, 3, 4, 4)
+
+    for t in range(1, 20):
+        obs, *_ = env.step(0)
+        oracle.append(np.full((3, 4, 4), t % 256, np.uint8))
+        expected = np.stack(list(oracle)[dilation - 1 :: dilation])
+        np.testing.assert_array_equal(obs["rgb"], expected)

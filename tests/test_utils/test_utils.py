@@ -27,11 +27,24 @@ def test_ratio_accumulates():
     assert r(8) == 2
     state = r.state_dict()
     r2 = Ratio(ratio=0.1).load_state_dict(state)
-    assert r2(12) == r_expected(state, 12)
+    assert r2(12) == int((12 - state["last_step"]) * state["ratio"] + state["credit"])
 
 
-def r_expected(state, step):
-    return int((step - state["_prev"]) * state["_ratio"])
+def test_ratio_carries_fractional_credit():
+    # ratio 0.3 over unit steps: payouts must sum to ~0.3/step without drift
+    r = Ratio(ratio=0.3)
+    r(0)
+    total = sum(r(s) for s in range(1, 101))
+    assert 29 <= total <= 30  # exact up to float truncation of the last credit
+
+
+def test_ratio_pretrain_burst():
+    r = Ratio(ratio=2.0, pretrain_steps=8)
+    assert r(16) == 16  # burst = pretrain_steps * ratio
+    assert r(17) == 2  # back to steady-state ratio
+    with pytest.warns(UserWarning):
+        r2 = Ratio(ratio=1.0, pretrain_steps=100)
+        assert r2(10) == 10  # burst clamped to steps actually taken
 
 
 def test_ratio_validation():
