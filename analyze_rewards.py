@@ -1,0 +1,9 @@
+#!/usr/bin/env python3
+"""Repo-root shim for reward analysis (the fork keeps `analyze_rewards.py` at
+the repo root — /root/reference/analyze_rewards.py).
+Implementation: sheeprl_tpu/tools/analyze_rewards.py."""
+
+from sheeprl_tpu.tools.analyze_rewards import main
+
+if __name__ == "__main__":
+    main()

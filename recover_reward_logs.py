@@ -1,0 +1,9 @@
+#!/usr/bin/env python3
+"""Repo-root shim for reward-log recovery (the fork keeps
+`recover_reward_logs.py` at the repo root — /root/reference/recover_reward_logs.py).
+Implementation: sheeprl_tpu/tools/recover_rewards.py."""
+
+from sheeprl_tpu.tools.recover_rewards import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+#!/usr/bin/env python3
+"""Repo-root shim for the hyperparameter search harness (the fork keeps
+`search_phase1.py` at the repo root — /root/reference/search_phase1.py).
+Implementation: sheeprl_tpu/tools/search.py."""
+
+from sheeprl_tpu.tools.search import main
+
+if __name__ == "__main__":
+    main()

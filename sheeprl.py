@@ -1,7 +1,20 @@
 """Repo-root shim so ``python sheeprl.py ...`` works like the reference's
 root-level launcher (reference /root/reference/sheeprl.py)."""
 
-from sheeprl_tpu.cli import run
+import os
+import sys
+
+# The host BLAS/OpenMP pools size themselves when numpy loads, which happens
+# as soon as the package imports — so a `num_threads=N` override must be
+# applied to the environment *before* any import.
+for _arg in sys.argv[1:]:
+    if _arg.startswith("num_threads="):
+        _n = _arg.split("=", 1)[1]
+        if _n.isdigit() and int(_n) > 0:
+            for _var in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS"):
+                os.environ.setdefault(_var, _n)
+
+from sheeprl_tpu.cli import run  # noqa: E402
 
 if __name__ == "__main__":
     run()

@@ -46,10 +46,4 @@ _ALGO_MODULES = [
 ]
 
 for _mod in _ALGO_MODULES:
-    try:
-        importlib.import_module(_mod)
-    except ModuleNotFoundError as err:
-        # during the incremental build not every algorithm exists yet;
-        # tolerate only missing in-package modules, never real import errors
-        if not str(err.name or "").startswith("sheeprl_tpu"):
-            raise
+    importlib.import_module(_mod)

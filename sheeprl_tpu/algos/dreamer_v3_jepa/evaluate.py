@@ -1,0 +1,49 @@
+"""DreamerV3-JEPA evaluation entrypoint
+(reference /root/reference/sheeprl/algos/dreamer_v3_jepa/evaluate.py): identical
+shape to the DV3 evaluator — the JEPA heads only matter at train time, the
+player needs the world model + task actor."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3
+from sheeprl_tpu.algos.dreamer_v3.utils import test
+from sheeprl_tpu.algos.dreamer_v3_jepa.agent import build_agent
+from sheeprl_tpu.envs.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="dreamer_v3_jepa")
+def evaluate_dreamer_v3_jepa(runtime, cfg, state: Dict[str, Any]) -> None:
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    action_space = env.action_space
+    observation_space = env.observation_space
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    world_model_def, actor_def, critic_def, _jepa_heads, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"],
+        state["actor"],
+        state["critic"],
+        state.get("target_critic"),
+    )
+    player = PlayerDV3(world_model_def, actor_def, actions_dim, 1)
+    env.close()
+    cumulative_rew = test(player, params["world_model"], params["actor"], runtime, cfg, log_dir, greedy=False)
+    logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    logger.finalize()

@@ -62,6 +62,8 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
 
 def check_configs(cfg: dotdict) -> None:
     """Config validation (reference cli.py:271-345)."""
+    import warnings
+
     algo_name = cfg.algo.name
     entry = find_algorithm(algo_name)
     if entry is None:
@@ -69,8 +71,20 @@ def check_configs(cfg: dotdict) -> None:
         raise ValueError(
             f"Algorithm '{algo_name}' is not registered. Available algorithms: {registered}"
         )
+    if cfg.get("matmul_precision", "default") not in ("default", "high", "highest", "tensorfloat32", "bfloat16", "float32"):
+        raise ValueError(
+            f"Invalid 'matmul_precision' value {cfg.get('matmul_precision')!r}; "
+            "must be one of: default, high, highest, tensorfloat32, bfloat16, float32"
+        )
     devices = cfg.fabric.devices
+    strategy = str(cfg.fabric.get("strategy", "auto")).lower()
+    known_strategies = ("auto", "dp", "ddp", "single")
     if entry["decoupled"]:
+        if strategy not in ("auto", "dp", "ddp"):
+            raise ValueError(
+                f"Decoupled algorithm '{algo_name}' needs a data-parallel mesh "
+                f"(fabric.strategy=auto|dp), got {strategy!r}"
+            )
         n = devices if isinstance(devices, int) else 0
         if isinstance(devices, str) and devices not in ("auto", "-1"):
             n = int(devices)
@@ -79,8 +93,27 @@ def check_configs(cfg: dotdict) -> None:
                 f"Decoupled algorithm '{algo_name}' needs at least 2 devices "
                 f"(1 player + >=1 trainer), got fabric.devices={devices}"
             )
+    elif strategy not in known_strategies:
+        warnings.warn(
+            f"Unknown fabric.strategy {strategy!r}; the mesh runtime treats it as 'auto' "
+            f"(known: {known_strategies})",
+            UserWarning,
+        )
     if cfg.metric.log_level not in (0, 1):
         raise ValueError(f"metric.log_level must be 0 or 1, got {cfg.metric.log_level}")
+    learning_starts = cfg.algo.get("learning_starts")
+    if learning_starts is not None and learning_starts < 0:
+        raise ValueError("The `algo.learning_starts` parameter must be greater or equal to zero")
+    if cfg.env.get("action_repeat", 1) < 1:
+        cfg.env.action_repeat = 1
+    if not cfg.model_manager.get("disabled", True):
+        from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+        if not _IS_MLFLOW_AVAILABLE:
+            warnings.warn(
+                "MLFlow is not installed; setting model_manager.disabled=True", UserWarning
+            )
+            cfg.model_manager.disabled = True
 
 
 def check_configs_evaluation(cfg: dotdict) -> None:
@@ -88,9 +121,11 @@ def check_configs_evaluation(cfg: dotdict) -> None:
         raise ValueError("You must specify the evaluation checkpoint path: checkpoint_path=...")
 
 
-def run_algorithm(cfg: dotdict) -> None:
+def run_algorithm(cfg: dotdict):
     """Registry lookup → runtime instantiation → entrypoint launch
-    (reference cli.py:60-199)."""
+    (reference cli.py:60-199).  Returns whatever the entrypoint returns —
+    training mains return the final test reward when ``algo.run_test`` is on,
+    which the search harness uses as its objective."""
     entry = find_algorithm(cfg.algo.name)
     if entry is None:
         raise ValueError(f"Algorithm '{cfg.algo.name}' is not registered")
@@ -118,22 +153,40 @@ def run_algorithm(cfg: dotdict) -> None:
             cfg.model_manager.models = dotdict({k: v for k, v in mm.items() if k in models})
 
     runtime = instantiate(cfg.fabric)
-    runtime.launch(entrypoint, cfg)
+    profiler_cfg = cfg.metric.get("profiler", {})
+    if profiler_cfg.get("enabled", False):
+        # one trace around the whole run: compile + steps + host gaps all land
+        # in the same Perfetto timeline (SURVEY §5 profiling upgrade)
+        import jax
+
+        trace_dir = profiler_cfg.get("trace_dir") or os.path.join("logs", "profiler_trace")
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        try:
+            return runtime.launch(entrypoint, cfg)
+        finally:
+            jax.profiler.stop_trace()
+    return runtime.launch(entrypoint, cfg)
 
 
-def run(args: Optional[Sequence[str]] = None) -> None:
+def run(args: Optional[Sequence[str]] = None):
     """Train entrypoint (reference cli.py:358-366).  ``args`` defaults to
     ``sys.argv[1:]`` — Hydra-style ``group=option``/``a.b=v`` overrides."""
     overrides = list(args if args is not None else sys.argv[1:])
     cfg = compose(overrides)
-    if cfg.get("num_threads"):
-        os.environ.setdefault("XLA_FLAGS", "")
+    n_threads = cfg.get("num_threads")
+    if n_threads and int(n_threads) > 0:
+        # host-side thread budget.  BLAS pools already initialized in this
+        # process ignore these (sheeprl.py sets them pre-import for the CLI
+        # path); they still cap async-env subprocesses, which inherit the env.
+        for var in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS"):
+            os.environ.setdefault(var, str(int(n_threads)))
     if cfg.checkpoint.resume_from:
         cfg = resume_from_checkpoint(cfg)
     print_config(cfg)
     check_configs(cfg)
     _apply_global_flags(cfg)
-    run_algorithm(cfg)
+    return run_algorithm(cfg)
 
 
 def _apply_global_flags(cfg: dotdict) -> None:

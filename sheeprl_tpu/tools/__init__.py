@@ -1,0 +1,3 @@
+"""Auxiliary tooling: hyperparameter search, reward-log recovery/analysis
+(the fork's repo-root scripts ``search_phase1.py``, ``recover_reward_logs.py``,
+``analyze_rewards.py`` — see each module for the reference mapping)."""

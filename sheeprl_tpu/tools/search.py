@@ -1,0 +1,252 @@
+"""Hyperparameter search harness.
+
+TPU-native re-design of the fork's Phase-1 JEPA search
+(/root/reference/search_phase1.py:1-568 + dreamer_v3_jepa_search.py:683-722).
+The reference drives Optuna with a Hyperband pruner around subprocess-style
+trials; this image has no Optuna, so the harness implements the same search
+shape self-contained:
+
+- a categorical search space (default: the reference's Phase-1 JEPA grid —
+  ``jepa_coef`` x ``jepa_ema`` x ``jepa_mask.erase_frac``);
+- random or grid sampling;
+- synchronous successive halving (the core of ASHA/Hyperband): every rung
+  multiplies the per-trial step budget by ``reduction_factor`` and keeps the
+  top ``1/reduction_factor`` of trials;
+- each trial runs IN PROCESS through the real CLI composer
+  (``sheeprl_tpu.cli.run``) with ``algo.run_test=True``; the objective is the
+  returned final-test cumulative reward.
+
+Artifacts mirror the reference: ``results.csv``, ``topk.json``,
+``best_config.yaml``, ``SUMMARY.md`` under ``--output-dir``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import itertools
+import json
+import math
+import os
+import random
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+import yaml
+
+#: the reference Phase-1 space (search_phase1.py:155-158)
+DEFAULT_SPACE: Dict[str, List[Any]] = {
+    "algo.jepa_coef": [0.3, 1.0, 3.0],
+    "algo.jepa_ema": [0.992, 0.996, 0.999],
+    "algo.jepa_mask.erase_frac": [0.4, 0.6],
+}
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="Successive-halving hyperparameter search")
+    parser.add_argument("--exp", type=str, default="dreamer_v3_jepa", help="exp config to search over")
+    parser.add_argument("--env", type=str, default=None, help="env config override (e.g. 'atari', 'dmc')")
+    parser.add_argument("--full-steps", type=int, required=True, help="full training steps of Phase 2")
+    parser.add_argument("--fidelity-frac", type=float, default=0.15, help="top-rung budget fraction")
+    parser.add_argument("--n-trials", type=int, default=20)
+    parser.add_argument("--reduction-factor", type=int, default=3, help="halving rate between rungs")
+    parser.add_argument("--rungs", type=int, default=2, help="number of successive-halving rungs")
+    parser.add_argument("--sampler", type=str, default="random", choices=["random", "grid"])
+    parser.add_argument("--seed0", type=int, default=0, help="base seed; trial i runs with seed0+i")
+    parser.add_argument("--output-dir", type=str, default="./runs/phase1")
+    parser.add_argument(
+        "--space",
+        type=str,
+        default=None,
+        help="JSON dict of {config.key: [choices...]} replacing the default JEPA space",
+    )
+    parser.add_argument(
+        "--override",
+        action="append",
+        default=[],
+        help="extra config overrides applied to every trial (repeatable)",
+    )
+    args = parser.parse_args(argv)
+    if args.full_steps <= 0:
+        raise ValueError(f"full_steps must be > 0, got {args.full_steps}")
+    if not 0.0 < args.fidelity_frac <= 1.0:
+        raise ValueError(f"fidelity_frac must be in (0, 1], got {args.fidelity_frac}")
+    if args.n_trials < 1:
+        raise ValueError(f"n_trials must be >= 1, got {args.n_trials}")
+    if args.reduction_factor < 2:
+        raise ValueError(f"reduction_factor must be >= 2, got {args.reduction_factor}")
+    return args
+
+
+def sample_trials(space: Dict[str, List[Any]], n_trials: int, sampler: str, seed: int) -> List[Dict[str, Any]]:
+    """Draw ``n_trials`` parameter assignments from a categorical space."""
+    keys = sorted(space)
+    if sampler == "grid":
+        grid = list(itertools.product(*(space[k] for k in keys)))
+        rng = random.Random(seed)
+        rng.shuffle(grid)
+        picks = (grid * math.ceil(n_trials / len(grid)))[:n_trials]
+        return [dict(zip(keys, p)) for p in picks]
+    rng = random.Random(seed)
+    return [{k: rng.choice(space[k]) for k in keys} for _ in range(n_trials)]
+
+
+def run_trial(
+    exp: str,
+    params: Dict[str, Any],
+    steps: int,
+    seed: int,
+    trial_dir: Path,
+    env: Optional[str] = None,
+    extra_overrides: Sequence[str] = (),
+) -> float:
+    """One training run through the real CLI; returns the final test reward
+    (``-inf`` on failure so the rung ranking drops the trial)."""
+    from sheeprl_tpu.cli import run
+
+    trial_dir.mkdir(parents=True, exist_ok=True)
+    overrides = [f"exp={exp}"]
+    if env:
+        overrides.append(f"env={env}")
+    overrides += [
+        f"algo.total_steps={steps}",
+        "algo.run_test=True",
+        f"seed={seed}",
+        f"root_dir={trial_dir}",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+    ]
+    overrides += [f"{k}={v}" for k, v in params.items()]
+    overrides += list(extra_overrides)
+    try:
+        reward = run(overrides)
+    except Exception as err:  # noqa: BLE001 - a failed trial must not kill the study
+        (trial_dir / "error.txt").write_text(f"{type(err).__name__}: {err}\n")
+        return float("-inf")
+    return float(reward) if reward is not None else float("-inf")
+
+
+def successive_halving(args: argparse.Namespace) -> List[Dict[str, Any]]:
+    """Run the study; returns per-trial result records (all rungs)."""
+    space = json.loads(args.space) if args.space else dict(DEFAULT_SPACE)
+    output_dir = Path(args.output_dir)
+    output_dir.mkdir(parents=True, exist_ok=True)
+
+    top_budget = max(1, int(math.ceil(args.full_steps * args.fidelity_frac)))
+    # rung budgets grow toward the top fidelity: b_r = top * rf^(r - last)
+    budgets = [
+        max(1, top_budget // (args.reduction_factor ** (args.rungs - 1 - r))) for r in range(args.rungs)
+    ]
+
+    trials = [
+        {"trial_id": i, "seed": args.seed0 + i, "params": p}
+        for i, p in enumerate(sample_trials(space, args.n_trials, args.sampler, args.seed0))
+    ]
+    records: List[Dict[str, Any]] = []
+    survivors = trials
+    for rung, budget in enumerate(budgets):
+        print(f"[search] rung {rung}: {len(survivors)} trials x {budget} steps")
+        scored = []
+        for t in survivors:
+            tic = time.time()
+            trial_dir = output_dir / f"trial_{t['trial_id']}" / f"rung_{rung}"
+            value = run_trial(
+                args.exp, t["params"], budget, t["seed"], trial_dir, args.env, args.override
+            )
+            record = {
+                "trial_id": t["trial_id"],
+                "rung": rung,
+                "steps": budget,
+                "seed": t["seed"],
+                **t["params"],
+                "eval_return": value,
+                "wall_time_s": round(time.time() - tic, 2),
+                "state": "COMPLETE" if math.isfinite(value) else "FAILED",
+            }
+            records.append(record)
+            with open(output_dir / f"trial_{t['trial_id']}" / "results.json", "w") as fp:
+                json.dump(record, fp, indent=2)
+            scored.append((value, t))
+            print(f"[search]   trial {t['trial_id']}: return={value:.4f}")
+        scored.sort(key=lambda x: x[0], reverse=True)
+        keep = max(1, len(scored) // args.reduction_factor)
+        survivors = [t for _, t in scored[:keep]]
+        if rung == len(budgets) - 1 or len(survivors) == 1:
+            break
+    return records
+
+
+def save_study(records: List[Dict[str, Any]], args: argparse.Namespace) -> None:
+    output_dir = Path(args.output_dir)
+    fieldnames = sorted({k for r in records for k in r})
+    with open(output_dir / "results.csv", "w", newline="") as fp:
+        writer = csv.DictWriter(fp, fieldnames=fieldnames)
+        writer.writeheader()
+        writer.writerows(records)
+
+    # rank by the best return any rung achieved
+    best_by_trial: Dict[int, Dict[str, Any]] = {}
+    for r in records:
+        cur = best_by_trial.get(r["trial_id"])
+        if cur is None or r["eval_return"] > cur["eval_return"]:
+            best_by_trial[r["trial_id"]] = r
+    ranked = sorted(best_by_trial.values(), key=lambda r: r["eval_return"], reverse=True)
+    param_keys = [k for k in ranked[0] if k.startswith("algo.") or k.startswith("env.")] if ranked else []
+
+    top_k = ranked[: min(6, len(ranked))]
+    with open(output_dir / "topk.json", "w") as fp:
+        json.dump(
+            [
+                {
+                    "rank": i + 1,
+                    "trial_id": r["trial_id"],
+                    "best_eval_return": r["eval_return"],
+                    "params": {k: r[k] for k in param_keys},
+                }
+                for i, r in enumerate(top_k)
+            ],
+            fp,
+            indent=2,
+        )
+
+    if ranked:
+        best = ranked[0]
+        best_cfg: Dict[str, Any] = {"exp": args.exp, "seed": best["seed"], "best_eval_return": best["eval_return"]}
+        for k in param_keys:
+            node = best_cfg
+            parts = k.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = best[k]
+        with open(output_dir / "best_config.yaml", "w") as fp:
+            yaml.safe_dump(best_cfg, fp, sort_keys=False)
+
+    with open(output_dir / "SUMMARY.md", "w") as fp:
+        fp.write("# Hyperparameter Search Summary\n\n")
+        fp.write(f"**Experiment**: {args.exp}\n")
+        fp.write(f"**Trials**: {args.n_trials} ({args.sampler} sampling, ")
+        fp.write(f"{args.rungs} rungs, reduction factor {args.reduction_factor})\n")
+        fp.write(f"**Top-rung budget**: {int(math.ceil(args.full_steps * args.fidelity_frac))} steps\n\n")
+        fp.write("| Rank | Trial | Best return | Params |\n|---|---|---|---|\n")
+        for i, r in enumerate(top_k):
+            params = ", ".join(f"{k.split('.')[-1]}={r[k]}" for k in param_keys)
+            fp.write(f"| {i + 1} | {r['trial_id']} | {r['eval_return']:.4f} | {params} |\n")
+        if ranked:
+            best = ranked[0]
+            fp.write("\n## Best command for Phase 2\n\n```bash\nsheeprl exp=" + args.exp)
+            for k in param_keys:
+                fp.write(f" \\\n  {k}={best[k]}")
+            fp.write(f" \\\n  algo.total_steps={args.full_steps}\n```\n")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    args = parse_args(argv)
+    records = successive_halving(args)
+    save_study(records, args)
+    finished = [r for r in records if r["state"] == "COMPLETE"]
+    print(f"[search] done: {len(finished)}/{len(records)} rung-runs completed -> {args.output_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,190 @@
+"""Smoke tests for the aux tooling: hyperparameter search, reward recovery,
+reward analysis, and the JEPA evaluation entrypoint (VERDICT r1 item 9)."""
+
+from __future__ import annotations
+
+import csv
+import json
+import sys
+from pathlib import Path
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.tools.analyze_rewards import analyze
+from sheeprl_tpu.tools.recover_rewards import list_runs, recover, save_csv
+from sheeprl_tpu.tools.search import main as search_main
+from sheeprl_tpu.tools.search import sample_trials
+
+
+PPO_TINY = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+    "buffer.memmap=False",
+    "metric.log_level=1",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+]
+
+
+def test_sample_trials_grid_covers_space():
+    space = {"a": [1, 2], "b": [10, 20]}
+    trials = sample_trials(space, 4, "grid", seed=0)
+    assert sorted((t["a"], t["b"]) for t in trials) == [(1, 10), (1, 20), (2, 10), (2, 20)]
+    rnd = sample_trials(space, 8, "random", seed=0)
+    assert len(rnd) == 8 and all(t["a"] in (1, 2) and t["b"] in (10, 20) for t in rnd)
+
+
+def test_search_two_trials_over_dummy_ppo(tmp_path):
+    out = tmp_path / "phase1"
+    search_main(
+        [
+            "--exp=ppo",
+            "--full-steps=128",
+            "--fidelity-frac=0.5",
+            "--n-trials=2",
+            "--rungs=1",
+            "--sampler=grid",
+            f"--output-dir={out}",
+            "--space",
+            json.dumps({"algo.ent_coef": [0.0, 0.01]}),
+            *[f"--override={o}" for o in PPO_TINY[1:]],  # everything but exp=
+        ]
+    )
+    assert (out / "results.csv").exists()
+    with open(out / "results.csv") as fp:
+        rows = list(csv.DictReader(fp))
+    assert len(rows) == 2
+    assert all(r["state"] == "COMPLETE" for r in rows), rows
+    topk = json.loads((out / "topk.json").read_text())
+    assert len(topk) == 2 and topk[0]["best_eval_return"] >= topk[1]["best_eval_return"]
+    assert (out / "best_config.yaml").exists()
+    assert "Best command" in (out / "SUMMARY.md").read_text()
+
+
+def test_recover_and_analyze_rewards(tmp_path, capsys):
+    # a real run gives us the TB event file...
+    with mock.patch.object(sys, "argv", ["sheeprl_tpu"]):
+        run(
+            [
+                "exp=ppo",
+                "env=dummy",
+                "env.id=discrete_dummy",
+                "env.num_envs=1",
+                "env.capture_video=False",
+                "fabric.devices=1",
+                "fabric.accelerator=cpu",
+                "buffer.memmap=False",
+                "metric.log_level=1",
+                "metric.log_every=1",
+                "dry_run=True",
+                "algo.rollout_steps=8",
+                "algo.per_rank_batch_size=4",
+                "algo.update_epochs=1",
+                "algo.run_test=False",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.cnn_keys.encoder=[]",
+            ]
+        )
+    runs = list_runs("logs/runs")
+    assert runs, "no recoverable runs found"
+    run_dir = Path(runs[0]["path"])
+
+    # ...and a *crashed* run leaves its memmap buffers on disk (a clean exit
+    # unlinks owned MemmapArrays in __del__; recovery targets crashes, like
+    # the reference's recover_reward_logs.py).  Simulate the survivors:
+    version_dirs = sorted(run_dir.glob("version_*"))
+    assert version_dirs, "run has no version dir"
+    buf_dir = version_dirs[0] / "memmap_buffer" / "rank_0" / "env_0"
+    buf_dir.mkdir(parents=True)
+    rewards = np.linspace(0, 1, 16, dtype=np.float32)
+    rewards.tofile(buf_dir / "rewards.memmap")
+
+    runs = list_runs("logs/runs")
+    assert "memmap" in runs[0]["formats"]
+    recovered = recover(str(run_dir), "all")
+    assert "memmap" in recovered
+    assert len(recovered["memmap"]) == 16
+    np.testing.assert_allclose(
+        [row["reward"] for row in recovered["memmap"]], rewards, rtol=1e-6
+    )
+    written = save_csv(recovered, str(tmp_path / "recovered"))
+    assert written
+    stats = analyze(written[-1])
+    assert stats["count"] == len(recovered[list(recovered)[-1]])
+
+
+def test_jepa_evaluate_roundtrip():
+    """Train a tiny JEPA run, then evaluate its checkpoint through the
+    registered eval entrypoint (the reference ships
+    dreamer_v3_jepa/evaluate.py; r1 had none)."""
+    from sheeprl_tpu.cli import eval_algorithm
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.utils.registry import find_evaluation
+
+    assert find_evaluation("dreamer_v3_jepa") is not None
+
+    args = [
+        "exp=dreamer_v3_jepa",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.num_envs=1",
+        "env.capture_video=False",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "buffer.memmap=False",
+        "buffer.size=8",
+        "metric.log_level=0",
+        "dry_run=True",
+        "checkpoint.save_last=True",
+        "algo.per_rank_batch_size=1",
+        "algo.per_rank_sequence_length=1",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[]",
+    ]
+    with mock.patch.object(sys, "argv", ["sheeprl_tpu"]):
+        run(args)
+    ckpts = sorted(Path("logs").rglob("*.ckpt"))
+    assert ckpts, "JEPA run wrote no checkpoint"
+
+    from sheeprl_tpu.cli import evaluation
+
+    with mock.patch.object(sys, "argv", ["sheeprl_tpu-eval"]):
+        evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu", "env.capture_video=False"])
+
+
+def test_profiler_trace_capture(tmp_path):
+    """metric.profiler.enabled wraps the run in jax.profiler trace collection
+    and leaves a trace on disk (VERDICT r1 item 10)."""
+    trace_dir = tmp_path / "trace"
+    with mock.patch.object(sys, "argv", ["sheeprl_tpu"]):
+        run(
+            PPO_TINY
+            + [
+                "dry_run=True",
+                "algo.run_test=False",
+                "metric.profiler.enabled=True",
+                f"metric.profiler.trace_dir={trace_dir}",
+            ]
+        )
+    traced = list(Path(trace_dir).rglob("*"))
+    assert any(p.is_file() for p in traced), "profiler produced no trace files"
