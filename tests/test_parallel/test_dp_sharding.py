@@ -213,3 +213,18 @@ def test_offpolicy_step_is_sharded_with_collectives(algo):
     jax.block_until_ready(out)
     losses = np.asarray(out[-1])
     assert np.isfinite(losses).all()
+
+
+def test_prefetch_staged_yields_all_slices_in_order():
+    """prefetch_staged must reproduce exactly the per-step slices (staged one
+    ahead) — content parity with the eager loop it replaced."""
+    import numpy as np
+
+    from sheeprl_tpu.parallel.dp import prefetch_staged
+
+    samples = {"x": np.arange(5 * 3, dtype=np.float32).reshape(5, 3)}
+    out = list(prefetch_staged(samples, 5, None, transform=lambda t: {"x": t["x"] * 2}))
+    assert len(out) == 5
+    for i, batch in enumerate(out):
+        np.testing.assert_allclose(np.asarray(batch["x"]), samples["x"][i] * 2)
+    assert list(prefetch_staged(samples, 0, None)) == []
