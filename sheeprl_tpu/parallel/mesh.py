@@ -9,7 +9,7 @@ a `psum` riding ICI.  Multi-host extends the same mesh over DCN via
 
 Axis conventions used across the framework:
 - ``data``: data-parallel axis (batch sharded, params replicated)
-- ``trainer``/player sub-meshes: decoupled topology (parallel/decoupled.py)
+- ``trainer``/player sub-meshes: decoupled topology (algos/ppo/ppo_decoupled.py)
 """
 
 from __future__ import annotations

@@ -1,0 +1,143 @@
+"""Golden tests: JAX implementations vs tensors recorded from the reference
+(SURVEY §7 hard part 1; VERDICT r1 item 7).
+
+Fixtures in ``tests/golden/dv3_goldens.npz`` were produced by running the
+reference's torch code once (``tests/golden/generate_goldens.py``) — covering
+the numerically idiosyncratic DV3 pieces: two-hot bucket interpolation, symlog
+targets, KL-balanced reconstruction loss with free nats, straight-through
+categoricals, TD(lambda), the percentile-EMA Moments, and the GRU cell's gate
+order/-1 update bias.  Agreement bar: 1e-4 in fp32 (quantile interpolation and
+LN rsqrt differ at ~1e-6).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v3.utils import init_moments_state, update_moments
+from sheeprl_tpu.models.blocks import LayerNormGRUCell
+from sheeprl_tpu.ops.distributions import (
+    Bernoulli,
+    MSEDistribution,
+    OneHotCategorical,
+    SymlogDistribution,
+    TwoHotEncodingDistribution,
+    kl_categorical,
+)
+from sheeprl_tpu.ops.numerics import compute_lambda_values
+
+GOLDEN = Path(__file__).parent.parent / "golden" / "dv3_goldens.npz"
+
+
+@pytest.fixture(scope="module")
+def g():
+    assert GOLDEN.exists(), "run tests/golden/generate_goldens.py to create fixtures"
+    return np.load(GOLDEN)
+
+
+def close(ours, golden, atol=1e-4, rtol=1e-4):
+    np.testing.assert_allclose(np.asarray(ours), golden, atol=atol, rtol=rtol)
+
+
+def test_two_hot_log_prob_and_mean(g):
+    d = TwoHotEncodingDistribution(jnp.asarray(g["twohot_logits"]), dims=1)
+    close(d.log_prob(jnp.asarray(g["twohot_x"])), g["twohot_log_prob"])
+    close(d.mean, g["twohot_mean"])
+
+
+def test_symlog_log_prob(g):
+    d = SymlogDistribution(jnp.asarray(g["symlog_mode"]), dims=1)
+    close(d.log_prob(jnp.asarray(g["symlog_target"])), g["symlog_log_prob"])
+
+
+def test_mse_log_prob(g):
+    d = MSEDistribution(jnp.asarray(g["mse_mode"]), dims=3)
+    close(d.log_prob(jnp.asarray(g["mse_target"])), g["mse_log_prob"], atol=3e-4)
+
+
+def test_bernoulli_log_prob_and_mode(g):
+    d = Bernoulli(jnp.asarray(g["bern_logits"]), event_dims=1)
+    close(d.log_prob(jnp.asarray(g["bern_target"])), g["bern_log_prob"])
+    close(d.mode[..., 0], g["bern_mode"][..., 0])
+
+
+def test_one_hot_categorical_log_prob_entropy_kl(g):
+    p = OneHotCategorical(jnp.asarray(g["ohc_p_logits"]), event_dims=1)
+    close(p.log_prob(jnp.asarray(g["ohc_value"])), g["ohc_log_prob"])
+    close(p.entropy(), g["ohc_entropy"])
+    kl = kl_categorical(jnp.asarray(g["ohc_p_logits"]), jnp.asarray(g["ohc_q_logits"]), event_dims=1)
+    close(kl, g["ohc_kl"])
+
+
+def test_reconstruction_loss_matches_reference(g):
+    po = {
+        "rgb": MSEDistribution(jnp.asarray(g["mse_mode"]), dims=3),
+        "state": SymlogDistribution(jnp.asarray(g["symlog_mode"]), dims=1),
+    }
+    observations = {"rgb": jnp.asarray(g["mse_target"]), "state": jnp.asarray(g["symlog_target"])}
+    pr = TwoHotEncodingDistribution(jnp.asarray(g["twohot_logits"]), dims=1)
+    pc = Bernoulli(jnp.asarray(g["bern_logits"]), event_dims=1)
+    out = reconstruction_loss(
+        po,
+        observations,
+        pr,
+        jnp.asarray(g["twohot_x"]),
+        jnp.asarray(g["ohc_p_logits"]),
+        jnp.asarray(g["ohc_q_logits"]),
+        0.5,
+        0.1,
+        1.0,
+        1.0,
+        pc,
+        jnp.asarray(g["bern_target"]),
+        1.0,
+    )
+    names = ["rec_loss", "kl", "state_loss", "reward_loss", "observation_loss", "continue_loss"]
+    for name, ours in zip(names, out):
+        close(ours, g[f"recloss_{name}"], atol=3e-4, rtol=3e-4)
+
+
+def test_compute_lambda_values_matches_reference(g):
+    lam = compute_lambda_values(
+        jnp.asarray(g["lambda_rewards"]),
+        jnp.asarray(g["lambda_values"]),
+        jnp.asarray(g["lambda_continues"]),
+        lmbda=0.95,
+    )
+    close(lam, g["lambda_out"])
+
+
+def test_moments_percentile_ema_matches_reference(g):
+    state = init_moments_state()
+    low1, invscale1, state = update_moments(
+        state, jnp.asarray(g["moments_seq1"]), 0.99, 1.0, 0.05, 0.95
+    )
+    close(low1, g["moments_low1"])
+    close(invscale1, g["moments_invscale1"])
+    low2, invscale2, state = update_moments(
+        state, jnp.asarray(g["moments_seq2"]), 0.99, 1.0, 0.05, 0.95
+    )
+    close(low2, g["moments_low2"])
+    close(invscale2, g["moments_invscale2"])
+
+
+def test_layer_norm_gru_cell_matches_reference(g):
+    """Same weights, same inputs → same new hidden state.  This pins the
+    joint-projection concat order (h before x), the gate order
+    (reset|cand|update), the reset*cand placement, and the -1 update bias."""
+    hid = g["gru_h"].shape[-1]
+    cell = LayerNormGRUCell(hidden_size=hid, use_bias=True, layer_norm=True, norm_eps=1e-3)
+    params = {
+        "params": {
+            "Dense_0": {"kernel": jnp.asarray(g["gru_linear_w"].T), "bias": jnp.asarray(g["gru_linear_b"])},
+            "LayerNorm_0": {"scale": jnp.asarray(g["gru_ln_scale"]), "bias": jnp.asarray(g["gru_ln_bias"])},
+        }
+    }
+    out = cell.apply(params, jnp.asarray(g["gru_h"]), jnp.asarray(g["gru_x"]))
+    close(out, g["gru_out"])
