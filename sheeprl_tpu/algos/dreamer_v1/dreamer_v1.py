@@ -34,7 +34,7 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialRepla
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import Bernoulli
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, normalize_staged, pmean_tree, prefetch_staged
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -467,20 +467,18 @@ def main(runtime, cfg):
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )
+
+                _normalize = partial(normalize_staged, cnn_keys=cnn_keys)
+
                 with timer("Time/train_time"):
-                    for i in range(per_rank_gradient_steps):
-                        # stage [T, B_total, ...] with B sharded over the mesh
-                        staged = stage(
-                            {k: np.asarray(v[i]) for k, v in local_data.items()},
-                            runtime.mesh if world_size > 1 else None,
-                            batch_axis=1,
-                        )
-                        batch = {}
-                        for k, arr in staged.items():
-                            arr = arr.astype(jnp.float32)
-                            if k in cnn_keys:
-                                arr = arr / 255.0 - 0.5
-                            batch[k] = arr
+                    # double-buffered staging (see parallel/dp.py)
+                    for batch in prefetch_staged(
+                        local_data,
+                        per_rank_gradient_steps,
+                        runtime.mesh if world_size > 1 else None,
+                        batch_axis=1,
+                        transform=_normalize,
+                    ):
                         rng_key, train_key = jax.random.split(rng_key)
                         params, opt_states, metrics = train_step(params, opt_states, batch, train_key)
                     train_step_count += 1

@@ -35,7 +35,7 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, 
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import Bernoulli
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, normalize_staged, pmean_tree, prefetch_staged
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -526,24 +526,21 @@ def main(runtime, cfg):
                         sequence_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
                         **sample_kwargs,
                     )
+                _normalize = partial(normalize_staged, cnn_keys=cnn_keys)
+
                 with timer("Time/train_time"):
-                    for i in range(per_rank_gradient_steps):
+                    # double-buffered staging (see parallel/dp.py)
+                    for batch in prefetch_staged(
+                        local_data,
+                        per_rank_gradient_steps,
+                        runtime.mesh if world_size > 1 else None,
+                        batch_axis=1,
+                        transform=_normalize,
+                    ):
                         if cumulative_grad_steps % cfg.algo.critic.per_rank_target_network_update_freq == 0:
                             tau = 1.0
                         else:
                             tau = 0.0
-                        # stage [T, B_total, ...] with B sharded over the mesh
-                        staged = stage(
-                            {k: np.asarray(v[i]) for k, v in local_data.items()},
-                            runtime.mesh if world_size > 1 else None,
-                            batch_axis=1,
-                        )
-                        batch = {}
-                        for k, arr in staged.items():
-                            arr = arr.astype(jnp.float32)
-                            if k in cnn_keys:
-                                arr = arr / 255.0 - 0.5
-                            batch[k] = arr
                         rng_key, train_key = jax.random.split(rng_key)
                         params, opt_states, metrics = train_step(
                             params, opt_states, batch, train_key, jnp.float32(tau)

@@ -184,6 +184,7 @@ class RecurrentModel(nn.Module):
     act: str = "silu"
     layer_norm: bool = True
     gru_layer_norm: bool = True
+    fused_gru: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
@@ -193,6 +194,7 @@ class RecurrentModel(nn.Module):
             use_bias=not self.gru_layer_norm,
             layer_norm=self.gru_layer_norm,
             norm_eps=self.eps,
+            fused=self.fused_gru,
         )(recurrent_state, feat)
 
 
@@ -246,6 +248,7 @@ class RSSM(nn.Module):
     gru_layer_norm: bool = True
     head_scale: float = 1.0
     tanh_initial_state: bool = True
+    fused_gru: bool = False
 
     def setup(self) -> None:
         self.recurrent_model = RecurrentModel(
@@ -255,6 +258,7 @@ class RSSM(nn.Module):
             act=self.act,
             layer_norm=self.layer_norm,
             gru_layer_norm=self.gru_layer_norm,
+            fused_gru=self.fused_gru,
         )
         stoch_flat = self.stochastic_size * self.discrete_size
         self.representation_model = _StochHead(
@@ -394,6 +398,7 @@ class WorldModel(nn.Module):
     gru_layer_norm: bool = True
     symlog_inputs: bool = True
     hafner_heads: bool = True  # uniform/zero head inits (DV3); -1 sentinel = default init
+    fused_gru: bool = False  # Pallas fused LayerNorm-GRU cell (TPU)
 
     def setup(self) -> None:
         self.cnn_encoder = (
@@ -444,6 +449,7 @@ class WorldModel(nn.Module):
             gru_layer_norm=self.gru_layer_norm,
             head_scale=1.0 if self.hafner_heads else -1,
             tanh_initial_state=self.learnable_initial_recurrent_state,
+            fused_gru=self.fused_gru,
         )
         self.cnn_decoder = (
             CNNDecoderDV3(
@@ -764,6 +770,7 @@ def build_agent(
         eps=eps,
         learnable_initial_recurrent_state=wm_cfg.learnable_initial_recurrent_state,
         decoupled_rssm=wm_cfg.decoupled_rssm,
+        fused_gru=wm_cfg.recurrent_model.get("fused_kernel", False),
     )
     actor_def = Actor(
         latent_state_size=latent_state_size,

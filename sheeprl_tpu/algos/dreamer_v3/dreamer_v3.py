@@ -54,7 +54,7 @@ from sheeprl_tpu.ops.distributions import (
     TwoHotEncodingDistribution,
 )
 from sheeprl_tpu.ops.numerics import compute_lambda_values
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, prefetch_staged
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, normalize_staged, pmean_tree, prefetch_staged
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -521,14 +521,30 @@ def _dreamer_main(
     )
 
     buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 2
-    rb = EnvIndependentReplayBuffer(
-        buffer_size,
-        n_envs=num_envs,
-        obs_keys=tuple(obs_keys),
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
-        buffer_cls=SequentialReplayBuffer,
-    )
+    use_device_buffer = bool(cfg.buffer.get("device", False))
+    if use_device_buffer and world_size > 1:
+        import warnings
+
+        warnings.warn(
+            "buffer.device=True is single-device only for now; falling back to the host buffer"
+        )
+        use_device_buffer = False
+    if use_device_buffer:
+        # HBM-resident replay: frames never leave the device after collection
+        # (sheeprl_tpu/data/device_buffer.py) — removes the ~B*T*H*W*C bytes
+        # of host->HBM traffic per gradient step that bound the e2e rate
+        from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
+
+        rb = DeviceSequentialReplayBuffer(buffer_size, n_envs=num_envs, obs_keys=tuple(obs_keys))
+    else:
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=num_envs,
+            obs_keys=tuple(obs_keys),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+            buffer_cls=SequentialReplayBuffer,
+        )
     buffer_state = state
     if buffer_state is None and cfg.buffer.get("load_from_exploration") and agent_state:
         # P2E finetuning may continue on the exploration replay buffer
@@ -618,11 +634,14 @@ def _dreamer_main(
         if "restart_on_exception" in infos:
             for i, agent_roe in enumerate(infos["restart_on_exception"]):
                 if agent_roe and not dones[i]:
-                    sub = rb.buffer[i]
-                    last_idx = (sub._pos - 1) % sub.buffer_size
-                    sub["terminated"][last_idx] = np.zeros_like(sub["terminated"][last_idx])
-                    sub["truncated"][last_idx] = np.ones_like(sub["truncated"][last_idx])
-                    sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
+                    if use_device_buffer:
+                        rb.mark_last_truncated(i)
+                    else:
+                        sub = rb.buffer[i]
+                        last_idx = (sub._pos - 1) % sub.buffer_size
+                        sub["terminated"][last_idx] = np.zeros_like(sub["terminated"][last_idx])
+                        sub["truncated"][last_idx] = np.ones_like(sub["truncated"][last_idx])
+                        sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
                     step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
 
         if "final_info" in infos and "episode" in infos["final_info"]:
@@ -678,22 +697,24 @@ def _dreamer_main(
                 per_rank_gradient_steps = 1
             if per_rank_gradient_steps > 0:
                 has_trained = True
-                local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * world_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
-                )
-                def _normalize(staged):
-                    # runs on device arrays (raw uint8 over the wire)
-                    batch = {}
-                    for k, arr in staged.items():
-                        arr = arr.astype(jnp.float32)
-                        if k in cnn_keys:
-                            arr = arr / 255.0 - 0.5
-                        batch[k] = arr
-                    return batch
+                _normalize = partial(normalize_staged, cnn_keys=cnn_keys)
 
-                with timer("Time/train_time"):
+                if use_device_buffer:
+                    # batches are gathered inside HBM — nothing to stage
+                    batches = (
+                        _normalize(b)
+                        for b in rb.sample(
+                            cfg.algo.per_rank_batch_size,
+                            sequence_length=cfg.algo.per_rank_sequence_length,
+                            n_samples=per_rank_gradient_steps,
+                        )
+                    )
+                else:
+                    local_data = rb.sample(
+                        cfg.algo.per_rank_batch_size * world_size,
+                        sequence_length=cfg.algo.per_rank_sequence_length,
+                        n_samples=per_rank_gradient_steps,
+                    )
                     # double-buffered staging: batch i+1 is device_put
                     # (async) while the device executes step i — the
                     # host-gather + transfer hide behind compute
@@ -704,6 +725,8 @@ def _dreamer_main(
                         batch_axis=1,
                         transform=_normalize,
                     )
+
+                with timer("Time/train_time"):
                     for batch in batches:
                         target_freq = cfg.algo.critic.get("per_rank_target_network_update_freq", 0)
                         if target_freq and cumulative_grad_steps % target_freq == 0:

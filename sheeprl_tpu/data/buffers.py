@@ -501,6 +501,19 @@ class EnvIndependentReplayBuffer:
         return {"buffers": [b.state_dict() for b in self._buf]}
 
     def load_state_dict(self, state: Dict[str, Any]) -> "EnvIndependentReplayBuffer":
+        if "filled" in state:
+            # DeviceSequentialReplayBuffer format: split the stacked storage
+            # back into per-env sub-buffers (checkpoints survive toggling
+            # buffer.device between runs)
+            for e, b in enumerate(self._buf):
+                b.load_state_dict(
+                    {
+                        "buffer": {k: np.asarray(v[:, e : e + 1]) for k, v in state["buffer"].items()},
+                        "pos": int(state["pos"][e]),
+                        "full": bool(state["filled"][e] >= self._buffer_size),
+                    }
+                )
+            return self
         for b, s in zip(self._buf, state["buffers"]):
             b.load_state_dict(s)
         return self

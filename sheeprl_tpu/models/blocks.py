@@ -182,6 +182,13 @@ class LayerNormGRUCell(nn.Module):
 
     Call as ``new_h = cell(h, x)`` — scan-ready: the concatenated
     ``[h, x] @ W`` projection is a single MXU matmul per step.
+
+    ``fused=True`` routes eligible shapes through the Pallas TPU kernel
+    (``sheeprl_tpu/ops/pallas_gru.py``): projection + LayerNorm + gates in one
+    VMEM-resident ``pallas_call``, with the weight matrix pinned in VMEM
+    across the batch grid.  The parameter tree is identical to the unfused
+    path, so the flag is a pure runtime choice.  ``fused_interpret`` runs the
+    kernel in interpreter mode (CPU tests).
     """
 
     hidden_size: int
@@ -190,15 +197,55 @@ class LayerNormGRUCell(nn.Module):
     norm_eps: float = 1e-3
     dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
+    fused: bool = False
+    fused_interpret: bool = False
 
     @nn.compact
     def __call__(self, h: jax.Array, x: jax.Array) -> jax.Array:
         joint = jnp.concatenate([h, x], axis=-1)
-        z = nn.Dense(
-            3 * self.hidden_size, use_bias=self.use_bias, dtype=self.dtype, param_dtype=self.param_dtype
-        )(joint)
-        if self.layer_norm:
-            z = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.dtype, param_dtype=self.param_dtype)(z)
+        dense = nn.Dense(
+            3 * self.hidden_size,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="Dense_0",
+        )
+        ln = (
+            nn.LayerNorm(
+                epsilon=self.norm_eps, dtype=self.dtype, param_dtype=self.param_dtype, name="LayerNorm_0"
+            )
+            if self.layer_norm
+            else None
+        )
+
+        use_fused = self.fused and self.layer_norm and joint.ndim == 2
+        if use_fused and not self.is_initializing():
+            from sheeprl_tpu.ops.pallas_gru import fused_gru_supported, fused_layernorm_gru
+
+            if fused_gru_supported(joint.shape[-1], self.hidden_size) and (
+                self.fused_interpret or jax.default_backend() == "tpu"
+            ):
+                params = self.variables["params"]
+                w = params["Dense_0"]["kernel"]
+                b = (
+                    params["Dense_0"]["bias"]
+                    if self.use_bias
+                    else jnp.zeros((3 * self.hidden_size,), w.dtype)
+                )
+                return fused_layernorm_gru(
+                    joint,
+                    w,
+                    b,
+                    params["LayerNorm_0"]["scale"],
+                    params["LayerNorm_0"]["bias"],
+                    h,
+                    float(self.norm_eps),
+                    self.fused_interpret,
+                )
+
+        z = dense(joint)
+        if ln is not None:
+            z = ln(z)
         reset, cand, update = jnp.split(z, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)

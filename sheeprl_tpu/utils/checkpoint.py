@@ -73,7 +73,18 @@ class CheckpointCallback:
         """Mark the last inserted step truncated before snapshotting
         (reference callback.py:91-123). Returns serializable buffer state."""
         from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, ReplayBuffer
+        from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
 
+        if isinstance(rb, DeviceSequentialReplayBuffer):
+            # surgery on the host snapshot — the live HBM buffer stays untouched,
+            # so no undo pass is needed
+            state = rb.state_dict()
+            trunc = state["buffer"].get("truncated")
+            if trunc is not None:
+                for e in range(rb.n_envs):
+                    if state["filled"][e] > 0:
+                        trunc[(state["pos"][e] - 1) % rb.buffer_size, e] = 1.0
+            return state
         if isinstance(rb, ReplayBuffer):
             if "truncated" in rb.buffer and not rb.empty:
                 self._saved_trunc = rb["truncated"][(rb._pos - 1) % rb.buffer_size, :].copy()
