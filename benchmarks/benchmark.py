@@ -1,0 +1,32 @@
+#!/usr/bin/env python3
+"""Wall-clock benchmark launcher (reference benchmarks/benchmark.py — which
+toggles algorithms by commenting code; here it's an argument):
+
+    python benchmarks/benchmark.py ppo [extra overrides ...]
+    python benchmarks/benchmark.py dreamer_v3 fabric.devices=2
+
+Runs the matching ``exp=<algo>_benchmarks`` preset through the real CLI and
+prints total wall-clock seconds (comparable to BASELINE.md §B / SURVEY §6
+group B numbers).
+"""
+
+import sys
+import time
+
+ALGOS = ("ppo", "a2c", "sac", "dreamer_v1", "dreamer_v2", "dreamer_v3")
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in ALGOS:
+        raise SystemExit(f"usage: benchmark.py {{{'|'.join(ALGOS)}}} [overrides ...]")
+    algo, extra = sys.argv[1], sys.argv[2:]
+
+    from sheeprl_tpu.cli import run
+
+    tic = time.perf_counter()
+    run([f"exp={algo}_benchmarks", *extra])
+    print(f"{time.perf_counter() - tic:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+#!/usr/bin/env python3
+"""Repo-root shim for evaluation (reference /root/reference/sheeprl_eval.py)."""
+
+from sheeprl_tpu.cli import evaluation
+
+if __name__ == "__main__":
+    evaluation()

@@ -1,0 +1,8 @@
+#!/usr/bin/env python3
+"""Repo-root shim for model registration (reference
+/root/reference/sheeprl_model_manager.py)."""
+
+from sheeprl_tpu.cli import registration
+
+if __name__ == "__main__":
+    registration()

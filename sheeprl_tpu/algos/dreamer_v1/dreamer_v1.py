@@ -30,11 +30,11 @@ from sheeprl_tpu.algos.dreamer_v1.utils import (  # noqa: F401
 )
 from sheeprl_tpu.algos.dreamer_v2.loss import normal_log_prob
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import Bernoulli
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, normalize_staged, pmean_tree, prefetch_staged
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -334,13 +334,8 @@ def main(runtime, cfg):
     )
 
     buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 4
-    rb = EnvIndependentReplayBuffer(
-        buffer_size,
-        n_envs=num_envs,
-        obs_keys=tuple(obs_keys),
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
-        buffer_cls=SequentialReplayBuffer,
+    rb, use_device_buffer = make_dreamer_replay_buffer(
+        cfg, world_size, num_envs, obs_keys, log_dir, buffer_size
     )
     if state and cfg.buffer.checkpoint and "rb" in state and state["rb"] is not None:
         rb.load_state_dict(state["rb"])
@@ -468,17 +463,16 @@ def main(runtime, cfg):
                     n_samples=per_rank_gradient_steps,
                 )
 
-                _normalize = partial(normalize_staged, cnn_keys=cnn_keys)
+                batches = train_batches(
+                    local_data,
+                    per_rank_gradient_steps,
+                    runtime.mesh if world_size > 1 else None,
+                    cnn_keys,
+                    use_device_buffer,
+                )
 
                 with timer("Time/train_time"):
-                    # double-buffered staging (see parallel/dp.py)
-                    for batch in prefetch_staged(
-                        local_data,
-                        per_rank_gradient_steps,
-                        runtime.mesh if world_size > 1 else None,
-                        batch_axis=1,
-                        transform=_normalize,
-                    ):
+                    for batch in batches:
                         rng_key, train_key = jax.random.split(rng_key)
                         params, opt_states, metrics = train_step(params, opt_states, batch, train_key)
                     train_step_count += 1

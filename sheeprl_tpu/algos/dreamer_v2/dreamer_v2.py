@@ -31,11 +31,11 @@ from sheeprl_tpu.algos.dreamer_v2.utils import (  # noqa: F401
     test,
 )
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import Bernoulli
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, normalize_staged, pmean_tree, prefetch_staged
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -373,27 +373,16 @@ def main(runtime, cfg):
     # ---- buffer: sequential or episode (reference dreamer_v2.py:496-517) --
     buffer_type = cfg.buffer.type.lower() if cfg.buffer.get("type") else "sequential"
     buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 4
-    if buffer_type == "sequential":
-        rb = EnvIndependentReplayBuffer(
-            buffer_size,
-            n_envs=num_envs,
-            obs_keys=tuple(obs_keys),
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
-            buffer_cls=SequentialReplayBuffer,
-        )
-    elif buffer_type == "episode":
-        rb = EpisodeBuffer(
-            buffer_size,
-            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
-            n_envs=num_envs,
-            obs_keys=tuple(obs_keys),
-            prioritize_ends=cfg.buffer.prioritize_ends,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
-        )
-    else:
-        raise ValueError(f"Unrecognized buffer type: must be one of `sequential` or `episode`: {buffer_type}")
+    rb, use_device_buffer = make_dreamer_replay_buffer(
+        cfg,
+        world_size,
+        num_envs,
+        obs_keys,
+        log_dir,
+        buffer_size,
+        buffer_type=buffer_type,
+        minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
+    )
     if state and cfg.buffer.checkpoint and "rb" in state and state["rb"] is not None:
         rb.load_state_dict(state["rb"])
 
@@ -526,17 +515,16 @@ def main(runtime, cfg):
                         sequence_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
                         **sample_kwargs,
                     )
-                _normalize = partial(normalize_staged, cnn_keys=cnn_keys)
+                batches = train_batches(
+                    local_data,
+                    per_rank_gradient_steps,
+                    runtime.mesh if world_size > 1 else None,
+                    cnn_keys,
+                    use_device_buffer,
+                )
 
                 with timer("Time/train_time"):
-                    # double-buffered staging (see parallel/dp.py)
-                    for batch in prefetch_staged(
-                        local_data,
-                        per_rank_gradient_steps,
-                        runtime.mesh if world_size > 1 else None,
-                        batch_axis=1,
-                        transform=_normalize,
-                    ):
+                    for batch in batches:
                         if cumulative_grad_steps % cfg.algo.critic.per_rank_target_network_update_freq == 0:
                             tau = 1.0
                         else:

@@ -44,7 +44,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401
     update_moments,
 )
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import (
@@ -54,7 +54,7 @@ from sheeprl_tpu.ops.distributions import (
     TwoHotEncodingDistribution,
 )
 from sheeprl_tpu.ops.numerics import compute_lambda_values
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, normalize_staged, pmean_tree, prefetch_staged
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -521,30 +521,12 @@ def _dreamer_main(
     )
 
     buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 2
-    use_device_buffer = bool(cfg.buffer.get("device", False))
-    if use_device_buffer and world_size > 1:
-        import warnings
-
-        warnings.warn(
-            "buffer.device=True is single-device only for now; falling back to the host buffer"
-        )
-        use_device_buffer = False
-    if use_device_buffer:
-        # HBM-resident replay: frames never leave the device after collection
-        # (sheeprl_tpu/data/device_buffer.py) — removes the ~B*T*H*W*C bytes
-        # of host->HBM traffic per gradient step that bound the e2e rate
-        from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
-
-        rb = DeviceSequentialReplayBuffer(buffer_size, n_envs=num_envs, obs_keys=tuple(obs_keys))
-    else:
-        rb = EnvIndependentReplayBuffer(
-            buffer_size,
-            n_envs=num_envs,
-            obs_keys=tuple(obs_keys),
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
-            buffer_cls=SequentialReplayBuffer,
-        )
+    # HBM-resident replay when buffer.device=True: frames never leave the
+    # device after collection (sheeprl_tpu/data/device_buffer.py) — removes
+    # the ~B*T*H*W*C bytes of host->HBM traffic per gradient step
+    rb, use_device_buffer = make_dreamer_replay_buffer(
+        cfg, world_size, num_envs, obs_keys, log_dir, buffer_size
+    )
     buffer_state = state
     if buffer_state is None and cfg.buffer.get("load_from_exploration") and agent_state:
         # P2E finetuning may continue on the exploration replay buffer
@@ -697,34 +679,18 @@ def _dreamer_main(
                 per_rank_gradient_steps = 1
             if per_rank_gradient_steps > 0:
                 has_trained = True
-                _normalize = partial(normalize_staged, cnn_keys=cnn_keys)
-
-                if use_device_buffer:
-                    # batches are gathered inside HBM — nothing to stage
-                    batches = (
-                        _normalize(b)
-                        for b in rb.sample(
-                            cfg.algo.per_rank_batch_size,
-                            sequence_length=cfg.algo.per_rank_sequence_length,
-                            n_samples=per_rank_gradient_steps,
-                        )
-                    )
-                else:
-                    local_data = rb.sample(
-                        cfg.algo.per_rank_batch_size * world_size,
-                        sequence_length=cfg.algo.per_rank_sequence_length,
-                        n_samples=per_rank_gradient_steps,
-                    )
-                    # double-buffered staging: batch i+1 is device_put
-                    # (async) while the device executes step i — the
-                    # host-gather + transfer hide behind compute
-                    batches = prefetch_staged(
-                        local_data,
-                        per_rank_gradient_steps,
-                        runtime.mesh if world_size > 1 else None,
-                        batch_axis=1,
-                        transform=_normalize,
-                    )
+                local_data = rb.sample(
+                    cfg.algo.per_rank_batch_size * (1 if use_device_buffer else world_size),
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                )
+                batches = train_batches(
+                    local_data,
+                    per_rank_gradient_steps,
+                    runtime.mesh if world_size > 1 else None,
+                    cnn_keys,
+                    use_device_buffer,
+                )
 
                 with timer("Time/train_time"):
                     for batch in batches:

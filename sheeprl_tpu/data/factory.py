@@ -1,0 +1,71 @@
+"""Replay-buffer selection shared by the Dreamer-family training loops
+(dreamer_v1/dreamer_v2's own mains and the shared ``_dreamer_main``).
+
+Centralizes the ``buffer.device`` decision — HBM-resident ring
+(``device_buffer.DeviceSequentialReplayBuffer``) vs host
+``EnvIndependentReplayBuffer``/``EpisodeBuffer`` — including the loud
+fallbacks when the device path cannot be used.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Optional, Sequence, Tuple
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+
+
+def make_dreamer_replay_buffer(
+    cfg,
+    world_size: int,
+    num_envs: int,
+    obs_keys: Sequence[str],
+    log_dir: str,
+    buffer_size: int,
+    buffer_type: str = "sequential",
+    minimum_episode_length: Optional[int] = None,
+) -> Tuple[object, bool]:
+    """Returns ``(rb, device_resident)``.
+
+    ``buffer.device=True`` selects the HBM-resident ring when eligible
+    (single device, sequential sampling); ineligible combinations fall back
+    to the host buffers with a warning so the performance-critical option is
+    never dropped silently.
+    """
+    want_device = bool(cfg.buffer.get("device", False))
+    if want_device and world_size > 1:
+        warnings.warn("buffer.device=True is single-device only for now; falling back to the host buffer")
+        want_device = False
+    if want_device and buffer_type != "sequential":
+        warnings.warn(
+            f"buffer.device=True requires sequential sampling, got buffer.type={buffer_type!r}; "
+            "falling back to the host buffer"
+        )
+        want_device = False
+    if want_device:
+        from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
+
+        return DeviceSequentialReplayBuffer(buffer_size, n_envs=num_envs, obs_keys=tuple(obs_keys)), True
+    if buffer_type == "sequential":
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=num_envs,
+            obs_keys=tuple(obs_keys),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+    elif buffer_type == "episode":
+        rb = EpisodeBuffer(
+            buffer_size,
+            minimum_episode_length=minimum_episode_length,
+            n_envs=num_envs,
+            obs_keys=tuple(obs_keys),
+            prioritize_ends=cfg.buffer.prioritize_ends,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+        )
+    else:
+        raise ValueError(f"Unrecognized buffer type: must be one of `sequential` or `episode`: {buffer_type}")
+    return rb, False

@@ -129,6 +129,21 @@ def normalize_staged(staged: Any, cnn_keys) -> Any:
     return batch
 
 
+def train_batches(local_data: Any, n: int, mesh: Optional[Mesh], cnn_keys, device_resident: bool):
+    """The Dreamer loops' per-gradient-step batch iterator.
+
+    Device-resident replay: ``local_data`` is already a list of HBM batches —
+    just normalize.  Host replay: double-buffer the host->HBM staging via
+    ``prefetch_staged``.
+    """
+    from functools import partial
+
+    _normalize = partial(normalize_staged, cnn_keys=cnn_keys)
+    if device_resident:
+        return (_normalize(b) for b in local_data)
+    return prefetch_staged(local_data, n, mesh, batch_axis=1, transform=_normalize)
+
+
 def prefetch_staged(samples: Any, n: int, mesh: Optional[Mesh], batch_axis: int = 0, transform=None):
     """Double-buffered host→HBM staging over the ``n`` gradient-step slices of
     a sampled super-batch (SURVEY §2.2 TPU note; VERDICT r1 item 10).

@@ -185,3 +185,49 @@ def test_cross_format_state_roundtrip():
     s = host2.sample(16, sequence_length=3)
     seqs = s["observations"][0, :, :, 0]
     np.testing.assert_allclose(np.diff(seqs, axis=0), 1.0)
+
+
+@pytest.mark.parametrize("exp", ["dreamer_v1", "dreamer_v2"])
+def test_dv1_dv2_e2e_with_device_buffer(exp):
+    import sys
+    from pathlib import Path
+    from unittest import mock
+
+    from sheeprl_tpu.cli import run
+
+    args = [
+        f"exp={exp}",
+        "dry_run=False",
+        "checkpoint.save_last=True",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "buffer.device=True",
+        "metric.log_level=0",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "algo.total_steps=16",
+        "algo.learning_starts=10",
+        "algo.replay_ratio=0.25",
+        "algo.per_rank_pretrain_steps=0",
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=4",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.cnn_keys.decoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.mlp_keys.decoder=[state]",
+        "algo.run_test=False",
+    ]
+    with mock.patch.object(sys, "argv", ["sheeprl_tpu"]):
+        run(args)
+    assert sorted(Path("logs").rglob("*.ckpt")), "no checkpoint written"
