@@ -680,7 +680,7 @@ def _dreamer_main(
             if per_rank_gradient_steps > 0:
                 has_trained = True
                 local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * (1 if use_device_buffer else world_size),
+                    cfg.algo.per_rank_batch_size * world_size,
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )

@@ -57,6 +57,8 @@ def make_dreamer_replay_buffer(
             buffer_cls=SequentialReplayBuffer,
         )
     elif buffer_type == "episode":
+        if minimum_episode_length is None:
+            raise ValueError("buffer_type='episode' requires minimum_episode_length")
         rb = EpisodeBuffer(
             buffer_size,
             minimum_episode_length=minimum_episode_length,

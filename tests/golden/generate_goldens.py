@@ -238,6 +238,98 @@ def main() -> None:
     out["gru_ln_scale"] = cell.layer_norm.weight.detach().numpy()
     out["gru_ln_bias"] = cell.layer_norm.bias.detach().numpy()
 
+    # ================= DreamerV2 fixtures ==================================
+    from sheeprl.algos.dreamer_v2.loss import reconstruction_loss as dv2_loss
+    from sheeprl.algos.dreamer_v2.utils import compute_lambda_values as dv2_lambda
+
+    po2 = {
+        "rgb": torch.distributions.Independent(
+            torch.distributions.Normal(torch.tensor(img_mode), 1.0), 3
+        ),
+        "state": torch.distributions.Independent(torch.distributions.Normal(torch.tensor(mode), 1.0), 1),
+    }
+    obs2 = {"rgb": torch.tensor(img_target), "state": torch.tensor(target)}
+    rew_mean = rng.normal(size=(T, B, 1)).astype(np.float32)
+    pr2 = torch.distributions.Independent(torch.distributions.Normal(torch.tensor(rew_mean), 1.0), 1)
+    pc2 = torch.distributions.Independent(BernoulliSafeMode(logits=torch.tensor(blogits)), 1)
+    out["dv2_rew_mean"] = rew_mean
+    # only kl_free_avg=True: the reference's False branch crashes
+    # (dreamer_v2/loss.py:77-78 passes a float to torch.maximum), so it has
+    # no runnable reference semantics to pin
+    rec2 = dv2_loss(
+        po2,
+        obs2,
+        pr2,
+        torch.tensor(x),
+        torch.tensor(p_logits),
+        torch.tensor(q_logits),
+        kl_balancing_alpha=0.8,
+        kl_free_nats=1.0,
+        kl_free_avg=True,
+        kl_regularizer=1.0,
+        pc=pc2,
+        continue_targets=torch.tensor(btarget),
+        discount_scale_factor=0.5,
+    )
+    names2 = ["rec_loss", "kl", "state_loss", "reward_loss", "observation_loss", "continue_loss"]
+    for name, val in zip(names2, rec2):
+        out[f"dv2loss_avg_{name}"] = val.detach().numpy()
+
+    H2 = 6
+    boot = torch.tensor(vals[-1:])
+    lam2 = dv2_lambda(
+        torch.tensor(rew), torch.tensor(vals), torch.tensor(conts), bootstrap=boot, horizon=H2, lmbda=0.95
+    )
+    out["dv2_lambda_out"] = lam2.numpy()
+
+    # ================= DreamerV1 fixtures ==================================
+    from sheeprl.algos.dreamer_v1.loss import reconstruction_loss as dv1_loss
+    from sheeprl.algos.dreamer_v1.utils import compute_lambda_values as dv1_lambda
+
+    S1 = 6
+    post_mean = rng.normal(size=(T, B, S1)).astype(np.float32)
+    post_std = (0.1 + rng.uniform(size=(T, B, S1)) * 2).astype(np.float32)
+    prior_mean = rng.normal(size=(T, B, S1)).astype(np.float32)
+    prior_std = (0.1 + rng.uniform(size=(T, B, S1)) * 2).astype(np.float32)
+    out["dv1_post_mean"], out["dv1_post_std"] = post_mean, post_std
+    out["dv1_prior_mean"], out["dv1_prior_std"] = prior_mean, prior_std
+    posteriors_dist = torch.distributions.Independent(
+        torch.distributions.Normal(torch.tensor(post_mean), torch.tensor(post_std)), 1
+    )
+    priors_dist = torch.distributions.Independent(
+        torch.distributions.Normal(torch.tensor(prior_mean), torch.tensor(prior_std)), 1
+    )
+    # qc=None on purpose: the reference's DV1 continue branch adds a positive,
+    # un-negated log_prob (dreamer_v1/loss.py:92-93) which this repo fixes —
+    # golden only the agreed terms
+    rec1 = dv1_loss(
+        po2,
+        obs2,
+        pr2,
+        torch.tensor(x),
+        posteriors_dist,
+        priors_dist,
+        kl_free_nats=3.0,
+        kl_regularizer=1.0,
+        qc=None,
+        continue_targets=None,
+        continue_scale_factor=10.0,
+    )
+    for name, val in zip(
+        ["rec_loss", "kl", "state_loss", "reward_loss", "observation_loss", "continue_loss"], rec1
+    ):
+        out[f"dv1loss_{name}"] = val.detach().numpy()
+
+    lam1 = dv1_lambda(
+        torch.tensor(rew),
+        torch.tensor(vals),
+        torch.tensor(conts),
+        last_values=torch.tensor(vals[-1]),
+        horizon=H2,
+        lmbda=0.95,
+    )
+    out["dv1_lambda_out"] = lam1.numpy()
+
     np.savez_compressed(OUT, **out)
     print(f"wrote {OUT} with {len(out)} arrays")
 

@@ -141,3 +141,88 @@ def test_layer_norm_gru_cell_matches_reference(g):
     }
     out = cell.apply(params, jnp.asarray(g["gru_h"]), jnp.asarray(g["gru_x"]))
     close(out, g["gru_out"])
+
+
+# ---------------- DreamerV2 / DreamerV1 goldens -----------------------------
+
+
+def test_dv2_reconstruction_loss_matches_reference(g):
+    from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss as dv2_loss
+
+    recon = {"rgb": jnp.asarray(g["mse_mode"]), "state": jnp.asarray(g["symlog_mode"])}
+    observations = {"rgb": jnp.asarray(g["mse_target"]), "state": jnp.asarray(g["symlog_target"])}
+    pc = Bernoulli(jnp.asarray(g["bern_logits"]), event_dims=1)
+    out = dv2_loss(
+        recon,
+        observations,
+        jnp.asarray(g["dv2_rew_mean"]),
+        jnp.asarray(g["twohot_x"]),
+        jnp.asarray(g["ohc_p_logits"]),
+        jnp.asarray(g["ohc_q_logits"]),
+        kl_balancing_alpha=0.8,
+        kl_free_nats=1.0,
+        kl_free_avg=True,
+        kl_regularizer=1.0,
+        pc=pc,
+        continue_targets=jnp.asarray(g["bern_target"]),
+        discount_scale_factor=0.5,
+    )
+    names = ["rec_loss", "kl", "state_loss", "reward_loss", "observation_loss", "continue_loss"]
+    for name, ours in zip(names, out):
+        want = g[f"dv2loss_avg_{name}"]
+        if name == "kl":
+            # the reference returns the raw [T, B] KL tensor here (its loop
+            # only logs the mean); ours returns the mean directly
+            want = want.mean()
+        close(ours, want, atol=3e-4, rtol=3e-4)
+
+
+def test_dv1_reconstruction_loss_matches_reference(g):
+    from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss as dv1_loss
+
+    recon = {"rgb": jnp.asarray(g["mse_mode"]), "state": jnp.asarray(g["symlog_mode"])}
+    observations = {"rgb": jnp.asarray(g["mse_target"]), "state": jnp.asarray(g["symlog_target"])}
+    out = dv1_loss(
+        recon,
+        observations,
+        jnp.asarray(g["dv2_rew_mean"]),
+        jnp.asarray(g["twohot_x"]),
+        (jnp.asarray(g["dv1_post_mean"]), jnp.asarray(g["dv1_post_std"])),
+        (jnp.asarray(g["dv1_prior_mean"]), jnp.asarray(g["dv1_prior_std"])),
+        kl_free_nats=3.0,
+        kl_regularizer=1.0,
+        qc=None,
+        continue_targets=None,
+        continue_scale_factor=10.0,
+    )
+    names = ["rec_loss", "kl", "state_loss", "reward_loss", "observation_loss", "continue_loss"]
+    for name, ours in zip(names, out):
+        close(ours, g[f"dv1loss_{name}"], atol=3e-4, rtol=3e-4)
+
+
+def test_dv2_lambda_values_match_reference(g):
+    from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values as dv2_lambda
+
+    lam = dv2_lambda(
+        jnp.asarray(g["lambda_rewards"]),
+        jnp.asarray(g["lambda_values"]),
+        jnp.asarray(g["lambda_continues"]),
+        bootstrap=jnp.asarray(g["lambda_values"][-1:]),
+        horizon=6,
+        lmbda=0.95,
+    )
+    close(lam, g["dv2_lambda_out"])
+
+
+def test_dv1_lambda_values_match_reference(g):
+    from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values as dv1_lambda
+
+    lam = dv1_lambda(
+        jnp.asarray(g["lambda_rewards"]),
+        jnp.asarray(g["lambda_values"]),
+        jnp.asarray(g["lambda_continues"]),
+        last_values=jnp.asarray(g["lambda_values"][-1]),
+        horizon=6,
+        lmbda=0.95,
+    )
+    close(lam, g["dv1_lambda_out"])
