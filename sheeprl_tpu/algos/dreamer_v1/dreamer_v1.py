@@ -335,7 +335,7 @@ def main(runtime, cfg):
 
     buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 4
     rb, use_device_buffer = make_dreamer_replay_buffer(
-        cfg, world_size, num_envs, obs_keys, log_dir, buffer_size
+        cfg, world_size, num_envs, obs_keys, log_dir, buffer_size, mesh=runtime.mesh
     )
     if state and cfg.buffer.checkpoint and "rb" in state and state["rb"] is not None:
         rb.load_state_dict(state["rb"])

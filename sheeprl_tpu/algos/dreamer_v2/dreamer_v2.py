@@ -382,6 +382,7 @@ def main(runtime, cfg):
         buffer_size,
         buffer_type=buffer_type,
         minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
+        mesh=runtime.mesh,
     )
     if state and cfg.buffer.checkpoint and "rb" in state and state["rb"] is not None:
         rb.load_state_dict(state["rb"])

@@ -525,7 +525,7 @@ def _dreamer_main(
     # device after collection (sheeprl_tpu/data/device_buffer.py) — removes
     # the ~B*T*H*W*C bytes of host->HBM traffic per gradient step
     rb, use_device_buffer = make_dreamer_replay_buffer(
-        cfg, world_size, num_envs, obs_keys, log_dir, buffer_size
+        cfg, world_size, num_envs, obs_keys, log_dir, buffer_size, mesh=runtime.mesh
     )
     buffer_state = state
     if buffer_state is None and cfg.buffer.get("load_from_exploration") and agent_state:

@@ -12,11 +12,12 @@ replay ring in HBM:
 - per-env write heads: envs advance independently (episode-end rows are
   appended only to done envs), replacing the host path's one-sub-buffer-per-
   env ``EnvIndependentReplayBuffer`` + ``SequentialReplayBuffer`` pair;
-- ``sample`` draws sequence windows with the same age-space semantics as the
-  host ``SequentialReplayBuffer`` (windows never span an env's write head;
-  starts uniform over the valid range, env picked uniformly per sequence) but
-  the gather runs on device and the returned ``[T, B, ...]`` batch never
-  touches the host;
+- ``sample`` draws sequence windows with the host ``SequentialReplayBuffer``'s
+  age-space semantics (windows never span an env's write head; starts uniform
+  over each env's valid range) but the gather runs on device and the returned
+  ``[T, B, ...]`` batch never touches the host.  Env choice is uniform on a
+  single device; in multi-device mode it is *block-stratified* — each device's
+  batch block draws only from its own env shard (see ``_draw_env_idx``);
 - capacity math: DV3 Atari-100K (1e5 steps x 64x64x3 uint8) is ~1.2 GB — it
   fits v5e HBM next to the S model.  For bigger buffers keep the host path
   (``buffer.device=False``).
@@ -38,7 +39,9 @@ import numpy as np
 
 @partial(jax.jit, donate_argnums=(0,))
 def _scatter_rows(storage: jax.Array, step: jax.Array, rows: jax.Array, envs: jax.Array) -> jax.Array:
-    """storage [cap, n_envs, ...]; step [k, ...] written at (rows[k], envs[k])."""
+    """storage [cap, n_envs, ...]; step [k, ...] written at (rows[k], envs[k]).
+    Works for sharded storage too: the updates are tiny and the SPMD
+    partitioner applies each to the owning shard."""
     return storage.at[rows, envs].set(step)
 
 
@@ -51,6 +54,30 @@ def _gather_sequences(storage: jax.Array, starts: jax.Array, env_idx: jax.Array,
     return storage[rows, env_idx[None, :]]
 
 
+def _make_sharded_gather(mesh, seq_len: int):
+    """Per-device local gather over an env-sharded ring (multi-device mode).
+
+    Inside ``shard_map`` every device sees only its env block; ``env_idx`` is
+    drawn block-stratified on the host so each device's indices are local.
+    The output batch leaves sharded ``P(None, "data")`` on the batch axis —
+    exactly the in_spec of the shard_map'd Dreamer train steps — with ZERO
+    cross-device traffic.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from sheeprl_tpu.parallel.dp import dp_jit
+
+    def local_gather(storage, starts, env_local):
+        return _gather_sequences(storage, starts, env_local, seq_len)
+
+    return dp_jit(
+        local_gather,
+        mesh,
+        in_specs=(P(None, "data"), P("data"), P("data")),
+        out_specs=P(None, "data"),
+    )
+
+
 class DeviceSequentialReplayBuffer:
     """Sequence replay living in HBM (single-host; per-env write heads).
 
@@ -61,7 +88,14 @@ class DeviceSequentialReplayBuffer:
     ``mark_last_truncated``.
     """
 
-    def __init__(self, buffer_size: int, n_envs: int = 1, obs_keys: Sequence[str] = (), **_: Any):
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = (),
+        mesh: Optional[Any] = None,
+        **_: Any,
+    ):
         if buffer_size <= 0:
             raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
         if n_envs <= 0:
@@ -73,6 +107,16 @@ class DeviceSequentialReplayBuffer:
         self._pos = np.zeros(self._n_envs, dtype=np.int64)
         self._filled = np.zeros(self._n_envs, dtype=np.int64)  # rows ever written, capped at size
         self._rng = np.random.default_rng()
+        # multi-device: the ring is sharded over the mesh's data axis along
+        # the env dimension; each device stores and samples only its env block
+        self._mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
+        self._world = int(self._mesh.devices.size) if self._mesh else 1
+        if self._mesh and self._n_envs % self._world != 0:
+            raise ValueError(
+                f"n_envs ({self._n_envs}) must be divisible by the mesh size ({self._world}) "
+                "for the env-sharded device buffer"
+            )
+        self._gather_cache: Dict[int, Any] = {}
 
     # -- properties mirrored from the host buffer ---------------------------
     @property
@@ -120,8 +164,8 @@ class DeviceSequentialReplayBuffer:
                     raise KeyError(
                         f"Unknown buffer key '{k}'; the buffer was initialized with {sorted(self._buf)}"
                     )
-                self._buf[k] = jnp.zeros(
-                    (self._buffer_size, self._n_envs, *v.shape[2:]), dtype=v.dtype
+                self._buf[k] = self._to_storage(
+                    jnp.zeros((self._buffer_size, self._n_envs, *v.shape[2:]), dtype=v.dtype)
                 )
         rows = jnp.asarray(self._pos[envs] % self._buffer_size, jnp.int32)
         envs_dev = jnp.asarray(envs, jnp.int32)
@@ -141,6 +185,32 @@ class DeviceSequentialReplayBuffer:
             self._buf["is_first"] = self._buf["is_first"].at[last, env_idx].set(0.0)
 
     # -- read path -----------------------------------------------------------
+    def _draw_env_idx(self, n: int, seq_len: int) -> np.ndarray:
+        valid_envs = np.nonzero(self._filled >= seq_len)[0]
+        if self._mesh is None:
+            if valid_envs.size == 0:
+                raise ValueError(
+                    f"Cannot sample a sequence of length {seq_len}. Data added so far: {self._filled.tolist()}"
+                )
+            return valid_envs[self._rng.integers(0, valid_envs.size, size=(n,))]
+        # env-sharded: each device's batch block draws only from its own env
+        # block (block-stratified rather than iid-uniform over all envs), so
+        # the shard_map gather stays fully local
+        if n % self._world != 0:
+            raise ValueError(f"batch_size ({n}) must be divisible by the mesh size ({self._world})")
+        n_local = self._n_envs // self._world
+        b_local = n // self._world
+        blocks = []
+        for d in range(self._world):
+            local_valid = valid_envs[(valid_envs >= d * n_local) & (valid_envs < (d + 1) * n_local)]
+            if local_valid.size == 0:
+                raise ValueError(
+                    f"Cannot sample a sequence of length {seq_len} from device {d}'s env block. "
+                    f"Data added so far: {self._filled.tolist()}"
+                )
+            blocks.append(local_valid[self._rng.integers(0, local_valid.size, size=(b_local,))])
+        return np.concatenate(blocks)
+
     def _draw(self, n: int, seq_len: int):
         """(starts, env_idx) numpy arrays for ``n`` valid sequence windows."""
         if self.empty or self._filled.max(initial=0) == 0:
@@ -149,12 +219,7 @@ class DeviceSequentialReplayBuffer:
             raise ValueError(
                 f"The sequence length ({seq_len}) is greater than the buffer size ({self._buffer_size})"
             )
-        valid_envs = np.nonzero(self._filled >= seq_len)[0]
-        if valid_envs.size == 0:
-            raise ValueError(
-                f"Cannot sample a sequence of length {seq_len}. Data added so far: {self._filled.tolist()}"
-            )
-        env_idx = valid_envs[self._rng.integers(0, valid_envs.size, size=(n,))]
+        env_idx = self._draw_env_idx(n, seq_len)
         filled = self._filled[env_idx]
         pos = self._pos[env_idx]
         # age of the window start, uniform over each env's valid range
@@ -175,17 +240,33 @@ class DeviceSequentialReplayBuffer:
             raise ValueError(
                 f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
             )
+        gather = None
+        if self._mesh is not None:
+            if sequence_length not in self._gather_cache:
+                self._gather_cache[sequence_length] = _make_sharded_gather(self._mesh, sequence_length)
+            gather = self._gather_cache[sequence_length]
         out = []
         for _ in range(n_samples):
             starts, env_idx = self._draw(batch_size, sequence_length)
-            starts = jnp.asarray(starts, jnp.int32)
-            env_idx = jnp.asarray(env_idx, jnp.int32)
-            out.append(
-                {
-                    k: _gather_sequences(v, starts, env_idx, sequence_length)
-                    for k, v in self._buf.items()
-                }
-            )
+            if self._mesh is not None:
+                # local env index within each device's block + sharded inputs
+                n_local = self._n_envs // self._world
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                idx_sharding = NamedSharding(self._mesh, P("data"))
+                starts_dev = jax.device_put(jnp.asarray(starts, jnp.int32), idx_sharding)
+                env_local = jax.device_put(jnp.asarray(env_idx % n_local, jnp.int32), idx_sharding)
+                out.append({k: gather(v, starts_dev, env_local) for k, v in self._buf.items()})
+            else:
+                starts = jnp.asarray(starts, jnp.int32)
+                env_idx = jnp.asarray(env_idx, jnp.int32)
+                out.append(
+                    {
+                        k: _gather_sequences(v, starts, env_idx, sequence_length)
+                        for k, v in self._buf.items()
+                    }
+                )
         return out
 
     # -- checkpointing ---------------------------------------------------------
@@ -198,6 +279,15 @@ class DeviceSequentialReplayBuffer:
             "filled": self._filled.copy(),
         }
 
+    def _to_storage(self, arr) -> jax.Array:
+        storage = jnp.asarray(arr)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            storage = jax.device_put(storage, NamedSharding(self._mesh, P(None, "data")))
+        return storage
+
     def load_state_dict(self, state: Dict[str, Any]) -> "DeviceSequentialReplayBuffer":
         if "buffers" in state:
             # host EnvIndependentReplayBuffer format (one sub-state per env):
@@ -206,7 +296,7 @@ class DeviceSequentialReplayBuffer:
             subs = state["buffers"]
             keys = subs[0]["buffer"].keys()
             self._buf = {
-                k: jnp.asarray(np.concatenate([np.asarray(s["buffer"][k]) for s in subs], axis=1))
+                k: self._to_storage(np.concatenate([np.asarray(s["buffer"][k]) for s in subs], axis=1))
                 for k in keys
             }
             self._pos = np.asarray([s["pos"] for s in subs], dtype=np.int64)
@@ -214,7 +304,7 @@ class DeviceSequentialReplayBuffer:
                 [self._buffer_size if s["full"] else s["pos"] for s in subs], dtype=np.int64
             )
             return self
-        self._buf = {k: jnp.asarray(v) for k, v in state["buffer"].items()}
+        self._buf = {k: self._to_storage(v) for k, v in state["buffer"].items()}
         self._pos = np.asarray(state["pos"], dtype=np.int64).copy()
         self._filled = np.asarray(state["filled"], dtype=np.int64).copy()
         return self

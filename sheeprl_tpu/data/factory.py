@@ -25,17 +25,22 @@ def make_dreamer_replay_buffer(
     buffer_size: int,
     buffer_type: str = "sequential",
     minimum_episode_length: Optional[int] = None,
+    mesh=None,
 ) -> Tuple[object, bool]:
     """Returns ``(rb, device_resident)``.
 
     ``buffer.device=True`` selects the HBM-resident ring when eligible
-    (single device, sequential sampling); ineligible combinations fall back
-    to the host buffers with a warning so the performance-critical option is
-    never dropped silently.
+    (sequential sampling; multi-device needs a ``mesh`` with
+    ``num_envs % world_size == 0`` — the ring is then env-sharded over the
+    data axis).  Ineligible combinations fall back to the host buffers with a
+    warning so the performance-critical option is never dropped silently.
     """
     want_device = bool(cfg.buffer.get("device", False))
-    if want_device and world_size > 1:
-        warnings.warn("buffer.device=True is single-device only for now; falling back to the host buffer")
+    if want_device and world_size > 1 and (mesh is None or num_envs % world_size != 0):
+        warnings.warn(
+            f"buffer.device=True with {world_size} devices needs the mesh and "
+            f"env.num_envs ({num_envs}) divisible by the device count; falling back to the host buffer"
+        )
         want_device = False
     if want_device and buffer_type != "sequential":
         warnings.warn(
@@ -46,7 +51,13 @@ def make_dreamer_replay_buffer(
     if want_device:
         from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
 
-        return DeviceSequentialReplayBuffer(buffer_size, n_envs=num_envs, obs_keys=tuple(obs_keys)), True
+        # the constructor ignores size-1 meshes, so pass it unconditionally
+        return (
+            DeviceSequentialReplayBuffer(
+                buffer_size, n_envs=num_envs, obs_keys=tuple(obs_keys), mesh=mesh
+            ),
+            True,
+        )
     if buffer_type == "sequential":
         rb = EnvIndependentReplayBuffer(
             buffer_size,
