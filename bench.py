@@ -51,15 +51,22 @@ _PEAKS = {
 }
 
 
-def _chip_peak(device_kind: str, precision: str) -> float:
+def _chip_peak(device_kind: str, precision: str):
+    """(peak FLOP/s, assumed) — ``assumed`` is True when the device kind is
+    not recognized and the v5e peak is used as a stand-in (the reported MFU
+    is then marked, not silently wrong — ADVICE r2)."""
     kind = device_kind.lower()
+    assumed = False
     if "v4" in kind:
         peaks = _PEAKS["v4"]
     elif "v5p" in kind:
         peaks = _PEAKS["v5p"]
+    elif "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
+        peaks = _PEAKS["default"]
     else:
         peaks = _PEAKS["default"]
-    return peaks["bf16"] if "bf16" in precision or "16" in precision else peaks["f32"]
+        assumed = True
+    return peaks["bf16"] if "bf16" in precision or "16" in precision else peaks["f32"], assumed
 
 
 def _build(cfg_overrides, actions_dim=(6,)):
@@ -164,7 +171,7 @@ def measure_compute(precision: str):
     assert np.isfinite(final_metrics).all()
     step_s = elapsed / MEASURE_STEPS
     device_kind = jax.devices()[0].device_kind
-    peak = _chip_peak(device_kind, precision)
+    peak, peak_assumed = _chip_peak(device_kind, precision)
     tflops = (flops / step_s / 1e12) if flops else None
     mfu = (flops / step_s) / peak if flops else None
     out = {
@@ -175,6 +182,8 @@ def measure_compute(precision: str):
         "mfu": round(mfu, 4) if mfu else None,
         "device_kind": device_kind,
     }
+    if peak_assumed:
+        out["peak_assumed"] = "unrecognized device kind — MFU uses the v5e peak as a stand-in"
     if tflops and tflops * 1e12 > peak:
         out["timing_suspect"] = (
             "implied FLOP/s exceeds chip peak — treat compute timing as unreliable"

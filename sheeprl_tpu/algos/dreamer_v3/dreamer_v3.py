@@ -573,10 +573,40 @@ def _dreamer_main(
     cumulative_grad_steps = 0
     has_trained = bool(cfg.checkpoint.resume_from)
 
+    def split_real_actions(actions: np.ndarray) -> np.ndarray:
+        if is_continuous:
+            return actions.reshape(num_envs, -1)
+        idxs = []
+        start = 0
+        for d in actions_dim:
+            idxs.append(np.argmax(actions[..., start : start + d], axis=-1))
+            start += d
+        return np.stack(idxs, axis=-1)
+
+    # Train-step metrics are kept as device arrays and fetched in batches:
+    # through a remote-device tunnel a blocking value fetch costs a full
+    # round trip (~100 ms measured), so the hot loop never fetches per-step.
+    pending_metrics: list = []
+    metric_rows: list = []
+
+    def drain_metrics(force: bool = False) -> None:
+        if pending_metrics and (force or len(pending_metrics) >= 256):
+            metric_rows.extend(np.asarray(jnp.stack(pending_metrics)))
+            pending_metrics.clear()
+
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
 
+        # ---- policy forward + replay write (dispatch; fetch deferred) -----
+        # Pipelined iteration: the player forward is *dispatched*, the step is
+        # written into the replay buffer (device-resident actions stay on
+        # device), this iteration's gradient steps are dispatched, and only
+        # THEN is the action value fetched for `envs.step` — so the fetch's
+        # tunnel round trip and the host-side env stepping both overlap the
+        # device executing the gradient steps (reference hot loop
+        # dreamer_v3.py:637-672 serializes these).
         with timer("Time/env_interaction_time"):
+            actions_jnp = None
             if iter_num <= learning_starts and not cfg.checkpoint.resume_from:
                 real_actions = actions = np.asarray(envs.action_space.sample())
                 if not is_continuous:
@@ -587,25 +617,68 @@ def _dreamer_main(
                         ],
                         axis=-1,
                     )
+                step_data["actions"] = actions.reshape(1, num_envs, -1)
             else:
                 rng_key, step_key = jax.random.split(rng_key)
                 torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
                 actions_jnp = player.get_actions(
                     params["world_model"], player_actor_fn(params, has_trained), torch_obs, step_key
                 )
-                actions = np.asarray(actions_jnp)
-                if is_continuous:
-                    real_actions = actions.reshape(num_envs, -1)
+                if use_device_buffer:
+                    step_data["actions"] = jnp.reshape(actions_jnp, (1, num_envs, -1))
                 else:
-                    idxs = []
-                    start = 0
-                    for d in actions_dim:
-                        idxs.append(np.argmax(actions[..., start : start + d], axis=-1))
-                        start += d
-                    real_actions = np.stack(idxs, axis=-1)
-
-            step_data["actions"] = actions.reshape(1, num_envs, -1)
+                    actions = np.asarray(actions_jnp)
+                    actions_jnp = None
+                    real_actions = split_real_actions(actions)
+                    step_data["actions"] = actions.reshape(1, num_envs, -1)
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        # ---- dispatch this iteration's gradient steps ---------------------
+        # The sample includes everything up to and including the current
+        # policy step; episode-end bookkeeping rows from *this* step (known
+        # only after `envs.step`) become sampleable one iteration later.
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio(
+                (policy_step_count - prefill_steps * policy_steps_per_iter)
+            )
+            if cfg.dry_run:
+                per_rank_gradient_steps = 1
+            if per_rank_gradient_steps > 0:
+                has_trained = True
+                local_data = rb.sample(
+                    cfg.algo.per_rank_batch_size * world_size,
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                )
+                batches = train_batches(
+                    local_data,
+                    per_rank_gradient_steps,
+                    runtime.mesh if world_size > 1 else None,
+                    cnn_keys,
+                    use_device_buffer,
+                )
+
+                with timer("Time/train_time"):
+                    for batch in batches:
+                        target_freq = cfg.algo.critic.get("per_rank_target_network_update_freq", 0)
+                        if target_freq and cumulative_grad_steps % target_freq == 0:
+                            tau = 1.0 if cumulative_grad_steps == 0 else cfg.algo.critic.get("tau", 1.0)
+                        else:
+                            tau = 0.0
+                        rng_key, train_key = jax.random.split(rng_key)
+                        params, opt_states, moments_state, metrics = train_step(
+                            params, opt_states, moments_state, batch, train_key, jnp.float32(tau)
+                        )
+                        cumulative_grad_steps += 1
+                    train_step_count += 1
+                pending_metrics.append(metrics)
+                drain_metrics()
+
+        # ---- fetch the actions, step the envs (device keeps training) -----
+        with timer("Time/env_interaction_time"):
+            if actions_jnp is not None:
+                actions = np.asarray(actions_jnp)
+                real_actions = split_real_actions(actions)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -670,47 +743,13 @@ def _dreamer_main(
             reset_mask[dones_idxes] = 1.0
             player.init_states(params["world_model"], reset_mask)
 
-        # ---- train (reference dreamer_v3.py:706-745) ----------------------
-        if iter_num >= learning_starts:
-            per_rank_gradient_steps = ratio(
-                (policy_step_count - prefill_steps * policy_steps_per_iter)
-            )
-            if cfg.dry_run:
-                per_rank_gradient_steps = 1
-            if per_rank_gradient_steps > 0:
-                has_trained = True
-                local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * world_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
-                )
-                batches = train_batches(
-                    local_data,
-                    per_rank_gradient_steps,
-                    runtime.mesh if world_size > 1 else None,
-                    cnn_keys,
-                    use_device_buffer,
-                )
-
-                with timer("Time/train_time"):
-                    for batch in batches:
-                        target_freq = cfg.algo.critic.get("per_rank_target_network_update_freq", 0)
-                        if target_freq and cumulative_grad_steps % target_freq == 0:
-                            tau = 1.0 if cumulative_grad_steps == 0 else cfg.algo.critic.get("tau", 1.0)
-                        else:
-                            tau = 0.0
-                        rng_key, train_key = jax.random.split(rng_key)
-                        params, opt_states, moments_state, metrics = train_step(
-                            params, opt_states, moments_state, batch, train_key, jnp.float32(tau)
-                        )
-                        cumulative_grad_steps += 1
-                    train_step_count += 1
-                metrics = np.asarray(metrics)
-                for name, value in zip(metric_order, metrics):
-                    aggregator.update(name, float(value))
-
         # ---- log (reference dreamer_v3.py:747-793) ------------------------
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
+            drain_metrics(force=True)
+            for row in metric_rows:
+                for name, value in zip(metric_order, row):
+                    aggregator.update(name, float(value))
+            metric_rows.clear()
             metrics_dict = aggregator.compute()
             timers = timer.compute()
             if timers.get("Time/train_time", 0) > 0:

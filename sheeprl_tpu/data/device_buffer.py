@@ -158,19 +158,27 @@ class DeviceSequentialReplayBuffer:
         envs = np.arange(self._n_envs) if indices is None else np.asarray(list(indices))
         was_empty = self.empty
         for k, v in data.items():
-            v = np.asarray(v)
             if k not in self._buf:
                 if not was_empty:
                     raise KeyError(
                         f"Unknown buffer key '{k}'; the buffer was initialized with {sorted(self._buf)}"
                     )
+                # .shape/.dtype work for numpy and jax leaves alike — no
+                # host round-trip for device-resident inputs
                 self._buf[k] = self._to_storage(
                     jnp.zeros((self._buffer_size, self._n_envs, *v.shape[2:]), dtype=v.dtype)
                 )
         rows = jnp.asarray(self._pos[envs] % self._buffer_size, jnp.int32)
         envs_dev = jnp.asarray(envs, jnp.int32)
         for k, v in data.items():
-            step = jnp.asarray(np.asarray(v)[0])  # [n_sel, ...] — KBs over the wire
+            # device leaves (e.g. the player's actions) stay on device: the
+            # slice is a dispatched op, never a blocking fetch — this is what
+            # lets the hot loop add the current step *before* fetching the
+            # action values (see dreamer_v3.py's pipelined iteration)
+            if isinstance(v, jax.Array):
+                step = v[0]
+            else:
+                step = jnp.asarray(np.asarray(v)[0])  # [n_sel, ...] — KBs over the wire
             self._buf[k] = _scatter_rows(self._buf[k], step, rows, envs_dev)
         self._pos[envs] = (self._pos[envs] + 1) % self._buffer_size
         self._filled[envs] = np.minimum(self._filled[envs] + 1, self._buffer_size)
