@@ -56,17 +56,17 @@ def _chip_peak(device_kind: str, precision: str):
     not recognized and the v5e peak is used as a stand-in (the reported MFU
     is then marked, not silently wrong — ADVICE r2)."""
     kind = device_kind.lower()
-    assumed = False
     if "v4" in kind:
         peaks = _PEAKS["v4"]
     elif "v5p" in kind:
         peaks = _PEAKS["v5p"]
-    elif "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
-        peaks = _PEAKS["default"]
     else:
+        # v5e/v5-lite get the default table as their own entry; anything else
+        # falls back to it with the MFU explicitly marked as estimated
         peaks = _PEAKS["default"]
-        assumed = True
-    return peaks["bf16"] if "bf16" in precision or "16" in precision else peaks["f32"], assumed
+        if not any(t in kind for t in ("v5 lite", "v5e", "v5lite")):
+            return peaks["bf16"] if "bf16" in precision or "16" in precision else peaks["f32"], True
+    return peaks["bf16"] if "bf16" in precision or "16" in precision else peaks["f32"], False
 
 
 def _build(cfg_overrides, actions_dim=(6,)):
@@ -81,7 +81,12 @@ def _build(cfg_overrides, actions_dim=(6,)):
     from sheeprl_tpu.parallel.precision import cast_floating, resolve_precision
 
     cfg = compose(cfg_overrides)
-    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    obs_space = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8),
+            "state": gym.spaces.Box(-np.inf, np.inf, (10,), np.float32),
+        }
+    )
     world_model_def, actor_def, critic_def, params = build_agent(
         None, actions_dim, False, cfg, obs_space
     )
@@ -101,8 +106,16 @@ def _build(cfg_overrides, actions_dim=(6,)):
     return cfg, world_model_def, actor_def, critic_def, params, opt_states, moments_state, train_step
 
 
-def measure_compute(precision: str):
-    """Per-step timed gradient steps + MFU on random device-resident data."""
+def measure_compute(
+    precision: str,
+    size: str = "S",
+    batch_size: int = 16,
+    measure_steps: int = MEASURE_STEPS,
+    extra_overrides=(),
+):
+    """Per-step timed gradient steps + MFU on random device-resident data.
+    ``extra_overrides`` lets the perf study isolate phases (horizon=1, short
+    sequences, vector-only observations)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -112,8 +125,8 @@ def measure_compute(precision: str):
             "exp=dreamer_v3",
             "env=dummy",
             "env.id=discrete_dummy",
-            "algo=dreamer_v3_S",
-            "algo.per_rank_batch_size=16",
+            f"algo=dreamer_v3_{size}",
+            f"algo.per_rank_batch_size={batch_size}",
             "algo.per_rank_sequence_length=64",
             "algo.cnn_keys.encoder=[rgb]",
             "algo.cnn_keys.decoder=[rgb]",
@@ -122,17 +135,21 @@ def measure_compute(precision: str):
             "env.capture_video=False",
             "metric.log_level=0",
             f"fabric.precision={precision}",
+            *extra_overrides,
         ]
     )
     T, B = cfg.algo.per_rank_sequence_length, cfg.algo.per_rank_batch_size
     rng = np.random.default_rng(0)
     batch = {
-        "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 3, 64, 64)), jnp.float32) / 255.0 - 0.5,
         "actions": jnp.asarray(rng.integers(0, 2, (T, B, 6)), jnp.float32),
         "rewards": jnp.asarray(rng.normal(size=(T, B, 1)), jnp.float32),
         "terminated": jnp.zeros((T, B, 1), jnp.float32),
         "is_first": jnp.zeros((T, B, 1), jnp.float32),
     }
+    for k in set(cfg.algo.cnn_keys.encoder) | set(cfg.algo.cnn_keys.decoder):
+        batch[k] = jnp.asarray(rng.integers(0, 255, (T, B, 3, 64, 64)), jnp.float32) / 255.0 - 0.5
+    for k in set(cfg.algo.mlp_keys.encoder) | set(cfg.algo.mlp_keys.decoder):
+        batch[k] = jnp.asarray(rng.normal(size=(T, B, 10)), jnp.float32)
     key = jax.random.PRNGKey(0)
     tau = jnp.float32(0.02)
 
@@ -161,7 +178,7 @@ def measure_compute(precision: str):
     # final metrics forces the entire N-step chain; amortized time per step
     # carries one tunnel round trip across all N steps.
     t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
+    for _ in range(measure_steps):
         key, sub = jax.random.split(key)
         params, opt_states, moments_state, metrics = train_step(
             params, opt_states, moments_state, batch, sub, tau
@@ -169,7 +186,7 @@ def measure_compute(precision: str):
     final_metrics = np.asarray(metrics)
     elapsed = time.perf_counter() - t0
     assert np.isfinite(final_metrics).all()
-    step_s = elapsed / MEASURE_STEPS
+    step_s = elapsed / measure_steps
     device_kind = jax.devices()[0].device_kind
     peak, peak_assumed = _chip_peak(device_kind, precision)
     tflops = (flops / step_s / 1e12) if flops else None
@@ -263,48 +280,78 @@ def measure_e2e(precision: str):
         step_data["truncated"] = np.asarray(trunc, np.float32).reshape(1, num_envs, 1)
         step_data["is_first"] = np.zeros((1, num_envs, 1), np.float32)
 
-    def one_iter(params, opt_states, moments_state, step_data, obs, key):
-        # player action (device inference)
+    from sheeprl_tpu.parallel.dp import normalize_staged
+
+    def one_iter(params, opt_states, moments_state, step_data, obs, key, pipelined):
+        """One policy step + one gradient step (ratio 1).
+
+        ``pipelined=True`` replicates the shipped hot loop's dispatch order
+        (sheeprl_tpu/algos/dreamer_v3/dreamer_v3.py:600-681): the player
+        forward is dispatched, its DEVICE-RESIDENT action array is written
+        into the HBM replay ring, the gradient step is dispatched, and only
+        then is the action value fetched for ``envs.step`` — the fetch's
+        tunnel round trip and host env stepping overlap device compute.
+        ``pipelined=False`` is the reference-style serialized order (fetch
+        action -> env.step -> train) for an apples-to-apples overlap number.
+        """
         key, k_step, k_train = jax.random.split(key, 3)
         torch_obs = prepare_obs(obs, cnn_keys=obs_keys, mlp_keys=[], num_envs=num_envs)
         actions_jnp = player.get_actions(params["world_model"], params["actor"], torch_obs, k_step)
-        actions = np.asarray(actions_jnp)
-        real_actions = np.argmax(actions, axis=-1)
-        step_data["actions"] = actions.reshape(1, num_envs, -1)
-        rb.add(step_data)
-        obs, rewards, term, trunc, _ = envs.step(real_actions.reshape(envs.action_space.shape))
-        for k in obs_keys:
-            step_data[k] = np.asarray(obs[k])[np.newaxis]
-        step_data["rewards"] = np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
-        step_data["terminated"] = np.asarray(term, np.float32).reshape(1, num_envs, 1)
-        step_data["truncated"] = np.asarray(trunc, np.float32).reshape(1, num_envs, 1)
-        step_data["is_first"] = np.zeros((1, num_envs, 1), np.float32)
-        # in-HBM sequence gather + 1 gradient step (ratio 1)
-        from sheeprl_tpu.parallel.dp import normalize_staged
 
+        def fetch_and_step_envs(step_data, obs):
+            actions = np.asarray(actions_jnp)
+            real_actions = np.argmax(actions, axis=-1)
+            obs, rewards, term, trunc, _ = envs.step(real_actions.reshape(envs.action_space.shape))
+            for k in obs_keys:
+                step_data[k] = np.asarray(obs[k])[np.newaxis]
+            step_data["rewards"] = np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
+            step_data["terminated"] = np.asarray(term, np.float32).reshape(1, num_envs, 1)
+            step_data["truncated"] = np.asarray(trunc, np.float32).reshape(1, num_envs, 1)
+            step_data["is_first"] = np.zeros((1, num_envs, 1), np.float32)
+            return step_data, obs
+
+        if pipelined:
+            step_data["actions"] = jnp.reshape(actions_jnp, (1, num_envs, -1))
+            rb.add(step_data)
+            # device->host copy overlaps the train dispatch below
+            actions_jnp.copy_to_host_async()
+        else:
+            actions = np.asarray(actions_jnp)
+            step_data["actions"] = actions.reshape(1, num_envs, -1)
+            rb.add(step_data)
+            step_data, obs = fetch_and_step_envs(step_data, obs)
+
+        # in-HBM sequence gather + 1 gradient step (ratio 1)
         (staged,) = rb.sample(B, sequence_length=T, n_samples=1)
         batch = normalize_staged(staged, obs_keys)
         params, opt_states, moments_state, metrics = train_step(
             params, opt_states, moments_state, batch, k_train, jnp.float32(0.02)
         )
+
+        if pipelined:
+            step_data, obs = fetch_and_step_envs(step_data, obs)
         return params, opt_states, moments_state, step_data, obs, key, metrics
 
-    for _ in range(E2E_WARMUP_ITERS):
-        params, opt_states, moments_state, step_data, obs, key, metrics = one_iter(
-            params, opt_states, moments_state, step_data, obs, key
-        )
-    jax.block_until_ready(metrics)
+    results = {}
+    for mode, pipelined in (("serialized", False), ("pipelined", True)):
+        for _ in range(E2E_WARMUP_ITERS):
+            params, opt_states, moments_state, step_data, obs, key, metrics = one_iter(
+                params, opt_states, moments_state, step_data, obs, key, pipelined
+            )
+        _ = np.asarray(metrics)  # value barrier (see measure_compute note)
 
-    t0 = time.perf_counter()
-    for _ in range(E2E_MEASURE_ITERS):
-        params, opt_states, moments_state, step_data, obs, key, metrics = one_iter(
-            params, opt_states, moments_state, step_data, obs, key
-        )
-    jax.block_until_ready(metrics)
-    elapsed = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(E2E_MEASURE_ITERS):
+            params, opt_states, moments_state, step_data, obs, key, metrics = one_iter(
+                params, opt_states, moments_state, step_data, obs, key, pipelined
+            )
+        _ = np.asarray(metrics)
+        elapsed = time.perf_counter() - t0
+        results[f"grad_steps_per_sec_e2e_{mode}"] = round(E2E_MEASURE_ITERS / elapsed, 3)
     envs.close()
     return {
-        "grad_steps_per_sec_e2e": round(E2E_MEASURE_ITERS / elapsed, 3),
+        "grad_steps_per_sec_e2e": results["grad_steps_per_sec_e2e_pipelined"],
+        **results,
         "replay": "device (HBM-resident ring)",
     }
 
@@ -323,6 +370,7 @@ def main() -> None:
                 "vs_baseline": round(value / BASELINE_E2E_GRAD_STEPS_PER_SEC, 3),
                 "baseline": "reference DV3-S Atari-100K: 25k grad steps / 14 h on RTX-3080 = 0.496/s e2e",
                 "precision": precision,
+                **{k: v for k, v in e2e.items() if k != "grad_steps_per_sec_e2e"},
                 **compute,
             }
         )

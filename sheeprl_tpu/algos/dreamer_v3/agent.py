@@ -610,8 +610,16 @@ class Actor(nn.Module):
             mean = jnp.tanh(mean)
         return mean, std
 
-    def act(self, state: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False) -> jax.Array:
-        """Sample (or take the mode of) the actions, concatenated over heads."""
+    def act(
+        self,
+        state: jax.Array,
+        key: Optional[jax.Array] = None,
+        greedy: bool = False,
+        mask=None,
+    ) -> jax.Array:
+        """Sample (or take the mode of) the actions, concatenated over heads.
+        ``mask`` is accepted for interface parity (reference agent.py:786) and
+        ignored; ``MinedojoActor`` consumes it."""
         pre_dist = self(state)
         if self.is_continuous:
             mean, std = self._continuous_dist_params(pre_dist[0])
@@ -634,8 +642,12 @@ class Actor(nn.Module):
                 actions = actions * jax.lax.stop_gradient(clip / jnp.maximum(clip, jnp.abs(actions)))
             return actions
         outs = []
+        functional_action = None
         for i, logits in enumerate(pre_dist):
             logits = _unimix(logits, logits.shape[-1], self.unimix)
+            # mask hook: identity here; MinedojoActor injects its hierarchy
+            # (unused functional_action/argmax chains are DCE'd by XLA)
+            logits = self._masked_logits_for_head(i, logits, functional_action, mask)
             if greedy:
                 idx = jnp.argmax(logits, axis=-1)
                 one_hot = jax.nn.one_hot(idx, logits.shape[-1], dtype=logits.dtype)
@@ -646,7 +658,16 @@ class Actor(nn.Module):
                 probs = jax.nn.softmax(logits, axis=-1)
                 one_hot = hard + probs - jax.lax.stop_gradient(probs)
             outs.append(one_hot)
+            if functional_action is None:
+                functional_action = jnp.argmax(outs[0], axis=-1)
         return jnp.concatenate(outs, axis=-1)
+
+    def _masked_logits_for_head(
+        self, i: int, logits: jax.Array, functional_action: Optional[jax.Array], mask
+    ) -> jax.Array:
+        """Per-head logit hook for hierarchical masking; base actor: identity."""
+        del i, functional_action, mask
+        return logits
 
     def log_prob_entropy(self, state: jax.Array, actions: jax.Array) -> Tuple[jax.Array, jax.Array]:
         """Log-prob of given (concatenated) actions + policy entropy, both
@@ -690,6 +711,73 @@ class Actor(nn.Module):
             sum(log_probs),
             sum(entropies),
         )
+
+
+class MinedojoActor(Actor):
+    """Hierarchically masked actor for MineDojo (reference agent.py:848-932).
+
+    MineDojo's MultiDiscrete action space is [action_type(19), craft_arg,
+    equip/place/destroy_arg]; the env publishes per-step validity masks as
+    ``mask_*`` observation keys (envs/minedojo.py).  Head 0 (action type) is
+    masked with ``mask_action_type``; head 1 (craft arg) is masked with
+    ``mask_craft_smelt`` only where the *sampled* action type is 15 (craft);
+    head 2 (destroy/equip/place arg) is masked with ``mask_equip_place``
+    where the sampled type is 16/17 and with ``mask_destroy`` where it is 18
+    (reference mask application at agent.py:905-928).  Masked categories get
+    ``-inf`` logits AFTER the unimix transform, so the remaining categories'
+    unimix-smoothed probabilities renormalize through the softmax.
+
+    The reference's per-(t, b) Python loops become vectorized ``jnp.where``
+    selections — the conditional masks depend only on the sampled functional
+    action, which is data, not control flow, so the whole hierarchy stays
+    inside one jitted graph.  The sampling loop itself is the base
+    ``Actor.act``; only the per-head logit hook is overridden, so the
+    straight-through/unimix semantics can never diverge between the two.
+    """
+
+    # MineDojo composite action-type indices that gate the argument heads
+    CRAFT_ACTION = 15
+    EQUIP_ACTION = 16
+    PLACE_ACTION = 17
+    DESTROY_ACTION = 18
+
+    def _masked_logits_for_head(
+        self, i: int, logits: jax.Array, functional_action: Optional[jax.Array], mask
+    ) -> jax.Array:
+        neg_inf = jnp.array(-jnp.inf, logits.dtype)
+        if mask is None:
+            return logits
+        if i == 0:
+            allowed = jnp.broadcast_to(mask["mask_action_type"].astype(bool), logits.shape)
+        elif i == 1:
+            craft = functional_action == self.CRAFT_ACTION  # [...]
+            allowed = jnp.where(
+                craft[..., None],
+                jnp.broadcast_to(mask["mask_craft_smelt"].astype(bool), logits.shape),
+                True,
+            )
+        elif i == 2:
+            equip_place = (functional_action == self.EQUIP_ACTION) | (
+                functional_action == self.PLACE_ACTION
+            )
+            destroy = functional_action == self.DESTROY_ACTION
+            allowed = jnp.where(
+                equip_place[..., None],
+                jnp.broadcast_to(mask["mask_equip_place"].astype(bool), logits.shape),
+                jnp.where(
+                    destroy[..., None],
+                    jnp.broadcast_to(mask["mask_destroy"].astype(bool), logits.shape),
+                    True,
+                ),
+            )
+        else:
+            return logits
+        return jnp.where(allowed, logits, neg_inf)
+
+    def setup(self) -> None:
+        if self.is_continuous:
+            raise ValueError("MinedojoActor only supports discrete (MultiDiscrete) action spaces")
+        super().setup()
 
 
 class Critic(nn.Module):
@@ -772,7 +860,16 @@ def build_agent(
         decoupled_rssm=wm_cfg.decoupled_rssm,
         fused_gru=wm_cfg.recurrent_model.get("fused_kernel", False),
     )
-    actor_def = Actor(
+    # cfg.algo.actor.cls selects the actor class (reference agent.py:1136-1141
+    # via hydra.utils.get_class); exp overlays pick MinedojoActor for MineDojo
+    actor_cls = Actor
+    if actor_cfg.get("cls"):
+        from sheeprl_tpu.config import get_callable
+
+        actor_cls = get_callable(actor_cfg.cls)
+        if not (isinstance(actor_cls, type) and issubclass(actor_cls, Actor)):
+            raise ValueError(f"algo.actor.cls must name an Actor subclass, got {actor_cfg.cls!r}")
+    actor_def = actor_cls(
         latent_state_size=latent_state_size,
         actions_dim=tuple(int(a) for a in actions_dim),
         is_continuous=is_continuous,
@@ -852,7 +949,7 @@ class PlayerDV3:
                 lambda i, s: reset_mask * i + (1 - reset_mask) * s, init, state
             )
 
-        def _step(wm_params, actor_params, state, obs, key, greedy):
+        def _step(wm_params, actor_params, state, obs, key, greedy, mask):
             k1, k2 = jax.random.split(key)
             embedded = wm.apply(wm_params, obs, method="encode")
             recurrent = wm.apply(
@@ -863,7 +960,7 @@ class PlayerDV3:
             else:
                 _, stochastic = wm.apply(wm_params, recurrent, embedded, k1, method="representation")
             latent = jnp.concatenate([stochastic, recurrent], axis=-1)
-            actions = actor_def.apply(actor_params, latent, k2, greedy, method="act")
+            actions = actor_def.apply(actor_params, latent, k2, greedy, mask, method="act")
             new_state = {"recurrent": recurrent, "stochastic": stochastic, "actions": actions}
             return actions, new_state
 
@@ -879,6 +976,8 @@ class PlayerDV3:
         else:
             self.state = self._reset_masked(wm_params, self.state, jnp.asarray(reset_mask, jnp.float32))
 
-    def get_actions(self, wm_params, actor_params, obs, key, greedy: bool = False) -> jax.Array:
-        actions, self.state = self._step(wm_params, actor_params, self.state, obs, key, greedy)
+    def get_actions(self, wm_params, actor_params, obs, key, greedy: bool = False, mask=None) -> jax.Array:
+        """``mask`` (dict of ``mask_*`` arrays, or None) feeds the hierarchical
+        action masking of ``MinedojoActor`` (reference dreamer_v3.py:614-617)."""
+        actions, self.state = self._step(wm_params, actor_params, self.state, obs, key, greedy, mask)
         return actions

@@ -621,8 +621,12 @@ def _dreamer_main(
             else:
                 rng_key, step_key = jax.random.split(rng_key)
                 torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+                # mask_* observation keys feed MinedojoActor's hierarchical
+                # action masking (reference dreamer_v3.py:614-617)
+                mask = {k: v for k, v in torch_obs.items() if k.startswith("mask")} or None
                 actions_jnp = player.get_actions(
-                    params["world_model"], player_actor_fn(params, has_trained), torch_obs, step_key
+                    params["world_model"], player_actor_fn(params, has_trained), torch_obs, step_key,
+                    mask=mask,
                 )
                 if use_device_buffer:
                     step_data["actions"] = jnp.reshape(actions_jnp, (1, num_envs, -1))
@@ -632,11 +636,22 @@ def _dreamer_main(
                     real_actions = split_real_actions(actions)
                     step_data["actions"] = actions.reshape(1, num_envs, -1)
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if actions_jnp is not None:
+                # start the device->host copy NOW: it proceeds while the
+                # gradient steps below are dispatched, so the blocking fetch
+                # before `envs.step` finds the values already (or nearly)
+                # landed instead of paying the full tunnel round trip there
+                actions_jnp.copy_to_host_async()
 
         # ---- dispatch this iteration's gradient steps ---------------------
         # The sample includes everything up to and including the current
         # policy step; episode-end bookkeeping rows from *this* step (known
         # only after `envs.step`) become sampleable one iteration later.
+        # Likewise the restart_on_exception truncation surgery (below) lands
+        # only after these gradient steps have sampled, so a crashed-env
+        # discontinuity can be trained on once as a normal transition — rare
+        # and bounded to one iteration (the reference patches before
+        # training; we accept the lag as the price of the overlap).
         if iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(
                 (policy_step_count - prefill_steps * policy_steps_per_iter)

@@ -94,7 +94,10 @@ def test(player, wm_params, actor_params, runtime, cfg, log_dir: str, test_name:
     while not done:
         key, sub = jax.random.split(key)
         torch_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, mlp_keys=cfg.algo.mlp_keys.encoder)
-        actions = np.asarray(player.get_actions(wm_params, actor_params, torch_obs, sub, greedy=greedy))
+        mask = {k: v for k, v in torch_obs.items() if k.startswith("mask")} or None
+        actions = np.asarray(
+            player.get_actions(wm_params, actor_params, torch_obs, sub, greedy=greedy, mask=mask)
+        )
         if player.actor_def.is_continuous:
             real_actions = actions.reshape(env.action_space.shape)
         else:

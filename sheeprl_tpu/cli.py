@@ -105,6 +105,10 @@ def check_configs(cfg: dotdict) -> None:
     if learning_starts is not None and learning_starts < 0:
         raise ValueError("The `algo.learning_starts` parameter must be greater or equal to zero")
     if cfg.env.get("action_repeat", 1) < 1:
+        warnings.warn(
+            f"env.action_repeat={cfg.env.action_repeat} is below the minimum of 1; clamping to 1",
+            UserWarning,
+        )
         cfg.env.action_repeat = 1
     if not cfg.model_manager.get("disabled", True):
         from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE

@@ -180,8 +180,21 @@ class FrameStack(gym.Wrapper):
         obs, reward, done, truncated, infos = self.env.step(action)
         slot = self._frames_seen % self._window
         self._frames_seen += 1
+        # DIAMBRA fight boundaries (round/stage/game done without the episode
+        # ending) restart play from a fresh scene: reflood the window with the
+        # new scene's first frame so the stack never straddles the boundary
+        # (reference wrappers.py:160-171).
+        reflood = (
+            infos.get("env_domain") == "DIAMBRA"
+            and {"round_done", "stage_done", "game_done"} <= infos.keys()
+            and (infos["round_done"] or infos["stage_done"] or infos["game_done"])
+            and not (done or truncated)
+        )
         for k, ring in self._ring.items():
-            ring[slot] = obs[k]
+            if reflood:
+                ring[:] = obs[k][None]
+            else:
+                ring[slot] = obs[k]
             obs[k] = self._stacked(k)
         return obs, reward, done, truncated, infos
 

@@ -103,6 +103,7 @@ def stage(tree: Any, mesh: Optional[Mesh], batch_axis: int = 0) -> Any:
     if dp_axis(mesh) is None:
         return jax.tree_util.tree_map(jnp.asarray, tree)
     sharding_cache = {}
+    multiprocess = len(getattr(mesh, "devices", np.empty(0)).ravel()) > len(jax.local_devices())
 
     def put(x):
         x = np.asarray(x)
@@ -111,6 +112,12 @@ def stage(tree: Any, mesh: Optional[Mesh], batch_axis: int = 0) -> Any:
         key = x.ndim
         if key not in sharding_cache:
             sharding_cache[key] = NamedSharding(mesh, P(*spec))
+        if multiprocess:
+            # DCN path: the mesh spans processes, so each host holds only ITS
+            # batch rows (the reference's per-rank DDP batches); assemble the
+            # global array from the process-local block — only local shards
+            # are transferred, the global view is logical.
+            return jax.make_array_from_process_local_data(sharding_cache[key], x)
         return jax.device_put(x, sharding_cache[key])
 
     return jax.tree_util.tree_map(put, tree)

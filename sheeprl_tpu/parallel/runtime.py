@@ -117,26 +117,40 @@ class Runtime:
         """Cast floating leaves to the compute dtype."""
         return cast_floating(tree, self.compute_dtype)
 
-    # -- host collectives (Fabric API surface) -----------------------------
+    # -- host collectives (Fabric API surface; executed by
+    # tests/test_parallel/test_multihost.py on a 2-process CPU mesh) --------
     def all_gather(self, tree: Any) -> Any:
         """Gather across *processes* (multi-host). In-process device-sharded
         values are already globally addressable, so this is the identity on a
         single host."""
         if jax.process_count() == 1:
             return tree
-        from jax.experimental import multihost_utils  # pragma: no cover
+        from jax.experimental import multihost_utils
 
-        return multihost_utils.process_allgather(tree)  # pragma: no cover
+        return multihost_utils.process_allgather(tree)
 
     def broadcast(self, obj: Any, src: int = 0) -> Any:
+        """Object broadcast (the reference's Gloo ``broadcast_object_list``,
+        e.g. the log-dir broadcast of utils/logger.py:78-114): arbitrary
+        picklable objects ride the array collective as length-prefixed bytes —
+        ``broadcast_one_to_all`` itself only ships numeric array pytrees."""
         if jax.process_count() == 1:
             return obj
-        from jax.experimental import multihost_utils  # pragma: no cover
+        import pickle
 
-        return multihost_utils.broadcast_one_to_all(obj)  # pragma: no cover
+        from jax.experimental import multihost_utils
+
+        is_src = jax.process_index() == src
+        payload = pickle.dumps(obj) if is_src else b""
+        n = int(
+            multihost_utils.broadcast_one_to_all(np.int32(len(payload)), is_source=is_src)
+        )
+        buf = np.frombuffer(payload, np.uint8) if is_src else np.zeros(n, np.uint8)
+        buf = np.asarray(multihost_utils.broadcast_one_to_all(buf, is_source=is_src), np.uint8)
+        return pickle.loads(buf.tobytes())
 
     def barrier(self) -> None:
-        if jax.process_count() > 1:  # pragma: no cover
+        if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("sheeprl_tpu_barrier")

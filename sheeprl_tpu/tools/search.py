@@ -2,16 +2,26 @@
 
 TPU-native re-design of the fork's Phase-1 JEPA search
 (/root/reference/search_phase1.py:1-568 + dreamer_v3_jepa_search.py:683-722).
-The reference drives Optuna with a Hyperband pruner around subprocess-style
-trials; this image has no Optuna, so the harness implements the same search
-shape self-contained:
+The reference drives Optuna (TPE sampler + Hyperband/ASHA pruner) around
+subprocess-style trials; this image has no Optuna, so the harness implements
+the same search shape self-contained:
 
 - a categorical search space (default: the reference's Phase-1 JEPA grid —
   ``jepa_coef`` x ``jepa_ema`` x ``jepa_mask.erase_frac``);
-- random or grid sampling;
-- synchronous successive halving (the core of ASHA/Hyperband): every rung
-  multiplies the per-trial step budget by ``reduction_factor`` and keeps the
-  top ``1/reduction_factor`` of trials;
+- ``random``, ``grid``, or ``tpe`` sampling — the TPE sampler is a
+  Tree-structured Parzen Estimator over categorical choices: observed trials
+  split into good (top ``gamma`` quantile) / bad, each candidate scored by
+  ``log l(x) - log g(x)`` with Laplace-smoothed per-key densities, best of
+  ``n_candidates`` drawn from ``l`` wins (Bergstra et al. 2011, the sampler
+  Optuna's TPESampler implements);
+- two schedulers: synchronous successive halving (every rung multiplies the
+  per-trial budget by ``reduction_factor`` and keeps the top
+  ``1/reduction_factor``) and ``asha`` — asynchronous successive halving
+  (Li et al. 2018): each new trial starts at rung 0 and is promoted rung by
+  rung whenever it ranks in the top ``1/reduction_factor`` of its rung's
+  results so far, so good configs reach high fidelity without waiting for a
+  full rung cohort, and the TPE sampler conditions each proposal on every
+  prior trial's highest-fidelity result;
 - each trial runs IN PROCESS through the real CLI composer
   (``sheeprl_tpu.cli.run``) with ``algo.run_test=True``; the objective is the
   returned final-test cumulative reward.
@@ -52,7 +62,14 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     parser.add_argument("--n-trials", type=int, default=20)
     parser.add_argument("--reduction-factor", type=int, default=3, help="halving rate between rungs")
     parser.add_argument("--rungs", type=int, default=2, help="number of successive-halving rungs")
-    parser.add_argument("--sampler", type=str, default="random", choices=["random", "grid"])
+    parser.add_argument("--sampler", type=str, default="tpe", choices=["random", "grid", "tpe"])
+    parser.add_argument(
+        "--scheduler", type=str, default="asha", choices=["halving", "asha"],
+        help="synchronous successive halving vs asynchronous (promotion-based) ASHA",
+    )
+    parser.add_argument("--tpe-gamma", type=float, default=0.25, help="TPE good-quantile")
+    parser.add_argument("--tpe-startup", type=int, default=8, help="random trials before TPE kicks in")
+    parser.add_argument("--tpe-candidates", type=int, default=24, help="TPE candidate draws per trial")
     parser.add_argument("--seed0", type=int, default=0, help="base seed; trial i runs with seed0+i")
     parser.add_argument("--output-dir", type=str, default="./runs/phase1")
     parser.add_argument(
@@ -92,6 +109,65 @@ def sample_trials(space: Dict[str, List[Any]], n_trials: int, sampler: str, seed
     return [{k: rng.choice(space[k]) for k in keys} for _ in range(n_trials)]
 
 
+class TPESampler:
+    """Tree-structured Parzen Estimator over a categorical space.
+
+    ``observations`` are ``(params, value)`` with HIGHER better.  Choices are
+    scored by ``log l(x) - log g(x)`` where ``l``/``g`` are Laplace-smoothed
+    empirical categoricals of the good/bad split at quantile ``gamma``; the
+    candidate maximizing the acquisition among ``n_candidates`` draws from
+    ``l`` is proposed.  Until ``n_startup`` observations exist, sampling is
+    uniform (Optuna TPESampler's ``n_startup_trials`` semantics)."""
+
+    def __init__(
+        self,
+        space: Dict[str, List[Any]],
+        seed: int = 0,
+        gamma: float = 0.25,
+        n_startup: int = 8,
+        n_candidates: int = 24,
+    ):
+        if not 0.0 < gamma < 1.0:
+            raise ValueError(f"gamma must be in (0, 1), got {gamma}")
+        self.space = {k: list(v) for k, v in space.items()}
+        self.keys = sorted(space)
+        self.rng = random.Random(seed)
+        self.gamma = gamma
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.observations: List[tuple] = []
+
+    def tell(self, params: Dict[str, Any], value: float) -> None:
+        if math.isfinite(value):
+            self.observations.append((params, value))
+
+    def _smoothed(self, values: List[Any], choices: List[Any]) -> Dict[Any, float]:
+        counts = {c: 1.0 for c in choices}  # Laplace prior
+        for v in values:
+            counts[v] = counts.get(v, 1.0) + 1.0
+        total = sum(counts.values())
+        return {c: counts[c] / total for c in choices}
+
+    def ask(self) -> Dict[str, Any]:
+        if len(self.observations) < self.n_startup:
+            return {k: self.rng.choice(self.space[k]) for k in self.keys}
+        ranked = sorted(self.observations, key=lambda o: o[1], reverse=True)
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        good, bad = ranked[:n_good], ranked[n_good:] or ranked[-1:]
+        l_dist = {k: self._smoothed([p[k] for p, _ in good], self.space[k]) for k in self.keys}
+        g_dist = {k: self._smoothed([p[k] for p, _ in bad], self.space[k]) for k in self.keys}
+        best, best_score = None, -math.inf
+        for _ in range(self.n_candidates):
+            cand = {
+                k: self.rng.choices(self.space[k], weights=[l_dist[k][c] for c in self.space[k]])[0]
+                for k in self.keys
+            }
+            score = sum(math.log(l_dist[k][cand[k]]) - math.log(g_dist[k][cand[k]]) for k in self.keys)
+            if score > best_score:
+                best, best_score = cand, score
+        return best
+
+
 def run_trial(
     exp: str,
     params: Dict[str, Any],
@@ -127,46 +203,96 @@ def run_trial(
     return float(reward) if reward is not None else float("-inf")
 
 
-def successive_halving(args: argparse.Namespace) -> List[Dict[str, Any]]:
-    """Run the study; returns per-trial result records (all rungs)."""
-    space = json.loads(args.space) if args.space else dict(DEFAULT_SPACE)
-    output_dir = Path(args.output_dir)
-    output_dir.mkdir(parents=True, exist_ok=True)
-
+def _rung_budgets(args: argparse.Namespace) -> List[int]:
+    """Budgets grow toward the top fidelity: b_r = top * rf^(r - last)."""
     top_budget = max(1, int(math.ceil(args.full_steps * args.fidelity_frac)))
-    # rung budgets grow toward the top fidelity: b_r = top * rf^(r - last)
-    budgets = [
+    return [
         max(1, top_budget // (args.reduction_factor ** (args.rungs - 1 - r))) for r in range(args.rungs)
     ]
 
-    trials = [
-        {"trial_id": i, "seed": args.seed0 + i, "params": p}
-        for i, p in enumerate(sample_trials(space, args.n_trials, args.sampler, args.seed0))
-    ]
+
+def _default_objective(args: argparse.Namespace):
+    output_dir = Path(args.output_dir)
+
+    def objective(params: Dict[str, Any], steps: int, seed: int, trial_id: int, rung: int) -> float:
+        trial_dir = output_dir / f"trial_{trial_id}" / f"rung_{rung}"
+        return run_trial(args.exp, params, steps, seed, trial_dir, args.env, args.override)
+
+    return objective
+
+
+def _make_sampler(args: argparse.Namespace, space: Dict[str, List[Any]]):
+    """An ask/tell sampler.  random/grid pre-draw the whole cohort; tpe
+    proposes sequentially from what it has seen."""
+    if args.sampler == "tpe":
+        return TPESampler(
+            space,
+            seed=args.seed0,
+            gamma=args.tpe_gamma,
+            n_startup=args.tpe_startup,
+            n_candidates=args.tpe_candidates,
+        )
+
+    class _Pre:
+        def __init__(self):
+            self._draws = iter(sample_trials(space, args.n_trials, args.sampler, args.seed0))
+
+        def ask(self) -> Dict[str, Any]:
+            return next(self._draws)
+
+        def tell(self, params: Dict[str, Any], value: float) -> None:
+            pass
+
+    return _Pre()
+
+
+def _record(records, output_dir, trial_id, rung, steps, seed, params, value, tic):
+    record = {
+        "trial_id": trial_id,
+        "rung": rung,
+        "steps": steps,
+        "seed": seed,
+        **params,
+        "eval_return": value,
+        "wall_time_s": round(time.time() - tic, 2),
+        "state": "COMPLETE" if math.isfinite(value) else "FAILED",
+    }
+    records.append(record)
+    trial_dir = output_dir / f"trial_{trial_id}"
+    trial_dir.mkdir(parents=True, exist_ok=True)
+    with open(trial_dir / "results.json", "w") as fp:
+        json.dump(record, fp, indent=2)
+    return record
+
+
+def successive_halving(args: argparse.Namespace, objective=None) -> List[Dict[str, Any]]:
+    """Synchronous successive halving; returns per-trial records (all rungs).
+    Rung 0 runs sequentially through the sampler's ask/tell loop, so the TPE
+    sampler conditions each proposal on every rung-0 result seen so far (the
+    cohort barrier means higher rungs complete only after sampling ends)."""
+    space = json.loads(args.space) if args.space else dict(DEFAULT_SPACE)
+    output_dir = Path(args.output_dir)
+    output_dir.mkdir(parents=True, exist_ok=True)
+    objective = objective or _default_objective(args)
+    budgets = _rung_budgets(args)
+    sampler = _make_sampler(args, space)
+
     records: List[Dict[str, Any]] = []
-    survivors = trials
+    survivors = []
     for rung, budget in enumerate(budgets):
-        print(f"[search] rung {rung}: {len(survivors)} trials x {budget} steps")
+        n = args.n_trials if rung == 0 else len(survivors)
+        print(f"[search] rung {rung}: {n} trials x {budget} steps")
         scored = []
-        for t in survivors:
+        for i in range(n):
+            if rung == 0:
+                t = {"trial_id": i, "seed": args.seed0 + i, "params": sampler.ask()}
+            else:
+                t = survivors[i]
             tic = time.time()
-            trial_dir = output_dir / f"trial_{t['trial_id']}" / f"rung_{rung}"
-            value = run_trial(
-                args.exp, t["params"], budget, t["seed"], trial_dir, args.env, args.override
-            )
-            record = {
-                "trial_id": t["trial_id"],
-                "rung": rung,
-                "steps": budget,
-                "seed": t["seed"],
-                **t["params"],
-                "eval_return": value,
-                "wall_time_s": round(time.time() - tic, 2),
-                "state": "COMPLETE" if math.isfinite(value) else "FAILED",
-            }
-            records.append(record)
-            with open(output_dir / f"trial_{t['trial_id']}" / "results.json", "w") as fp:
-                json.dump(record, fp, indent=2)
+            value = objective(t["params"], budget, t["seed"], t["trial_id"], rung)
+            if rung == 0:
+                sampler.tell(t["params"], value)
+            _record(records, output_dir, t["trial_id"], rung, budget, t["seed"], t["params"], value, tic)
             scored.append((value, t))
             print(f"[search]   trial {t['trial_id']}: return={value:.4f}")
         scored.sort(key=lambda x: x[0], reverse=True)
@@ -174,6 +300,53 @@ def successive_halving(args: argparse.Namespace) -> List[Dict[str, Any]]:
         survivors = [t for _, t in scored[:keep]]
         if rung == len(budgets) - 1 or len(survivors) == 1:
             break
+    return records
+
+
+def asha(args: argparse.Namespace, objective=None) -> List[Dict[str, Any]]:
+    """Asynchronous successive halving (Li et al. 2018), sequential driver.
+
+    Each trial starts at rung 0; after finishing rung r it is promoted to
+    rung r+1 immediately if it ranks in the top ``1/reduction_factor`` of all
+    rung-r results observed SO FAR (with at least ``reduction_factor``
+    results to rank against).  No rung-cohort barrier: a strong early trial
+    reaches the top fidelity while the study is still exploring, and the TPE
+    sampler conditions on every completed evaluation."""
+    space = json.loads(args.space) if args.space else dict(DEFAULT_SPACE)
+    output_dir = Path(args.output_dir)
+    output_dir.mkdir(parents=True, exist_ok=True)
+    objective = objective or _default_objective(args)
+    budgets = _rung_budgets(args)
+    eta = args.reduction_factor
+    sampler = _make_sampler(args, space)
+
+    records: List[Dict[str, Any]] = []
+    rung_values: List[List[float]] = [[] for _ in budgets]
+    for i in range(args.n_trials):
+        params = sampler.ask()
+        seed = args.seed0 + i
+        rung = 0
+        last_finite = -math.inf
+        while True:
+            tic = time.time()
+            value = objective(params, budgets[rung], seed, i, rung)
+            if math.isfinite(value):
+                last_finite = value
+            _record(records, output_dir, i, rung, budgets[rung], seed, params, value, tic)
+            print(f"[search]   trial {i} rung {rung}: return={value:.4f}")
+            rung_values[rung].append(value)
+            if rung + 1 >= len(budgets) or not math.isfinite(value):
+                break
+            seen = sorted(rung_values[rung], reverse=True)
+            top_k = max(1, len(seen) // eta)
+            if len(seen) >= eta and value >= seen[top_k - 1]:
+                rung += 1  # promoted: re-run at the next fidelity
+            else:
+                break
+        # the sampler conditions on the trial's HIGHEST-fidelity result (the
+        # least blurred view of the config, like Optuna studies that report
+        # the final intermediate value of pruned trials)
+        sampler.tell(params, last_finite)
     return records
 
 
@@ -242,7 +415,7 @@ def save_study(records: List[Dict[str, Any]], args: argparse.Namespace) -> None:
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     args = parse_args(argv)
-    records = successive_halving(args)
+    records = asha(args) if args.scheduler == "asha" else successive_halving(args)
     save_study(records, args)
     finished = [r for r in records if r["state"] == "COMPLETE"]
     print(f"[search] done: {len(finished)}/{len(records)} rung-runs completed -> {args.output_dir}")

@@ -42,9 +42,13 @@ class AbstractModelManager:
         raise NotImplementedError
 
 
+_VERSION_MD_TEMPLATE = "## **Version {}**\n"
+
+
 class MlflowModelManager(AbstractModelManager):
     """Register / transition / download / delete model versions in the MLflow
-    registry (reference mlflow.py:75-427)."""
+    registry, keeping a markdown changelog on both the registered model and
+    each version (reference mlflow.py:75-427)."""
 
     def __init__(self, runtime, tracking_uri: Optional[str] = None):
         if not _IS_MLFLOW_AVAILABLE:
@@ -60,12 +64,47 @@ class MlflowModelManager(AbstractModelManager):
         mlflow.set_tracking_uri(self.tracking_uri)
         self.client = MlflowClient()
 
+    # -- changelog helpers (reference mlflow.py:36-72) -----------------------
+    @staticmethod
+    def _get_author_and_date() -> str:
+        import getpass
+        from datetime import datetime
+
+        return (
+            f"**Author**: {getpass.getuser()}\n\n"
+            f"**Date**: {datetime.now().strftime('%d/%m/%Y %H:%M:%S')}\n\n"
+        )
+
+    @staticmethod
+    def _generate_description(description: Optional[str] = None) -> str:
+        return f"**Description**: {description}\n\n" if description else ""
+
+    def _safe_get_stage(self, model_name: str, version: int) -> Optional[str]:
+        try:
+            return self.client.get_model_version(model_name, str(version)).current_stage
+        except Exception:
+            warnings.warn(f"Model {model_name} version {version} not found")
+            return None
+
+    def _print(self, *args: Any) -> None:
+        printer = getattr(self.runtime, "print", print)
+        printer(*args)
+
+    # -- registry operations -------------------------------------------------
     def register_model(self, model_location: str, model_name: str, description=None, tags=None):
         import mlflow
 
         model_version = mlflow.register_model(model_uri=model_location, name=model_name, tags=tags)
-        if description:
-            self.client.update_model_version(model_name, model_version.version, description=description)
+        self._print(f"Registered model {model_name} with version {model_version.version}")
+        registered_description = self.client.get_registered_model(model_name).description or ""
+        header = "# MODEL CHANGELOG\n" if str(model_version.version) == "1" else ""
+        entry = _VERSION_MD_TEMPLATE.format(model_version.version)
+        entry += self._get_author_and_date()
+        entry += self._generate_description(description)
+        self.client.update_registered_model(model_name, header + registered_description + entry)
+        self.client.update_model_version(
+            model_name, model_version.version, "# MODEL CHANGELOG\n" + entry
+        )
         return model_version
 
     def get_latest_version(self, model_name: str):
@@ -73,7 +112,27 @@ class MlflowModelManager(AbstractModelManager):
         return max(versions, key=lambda v: int(v.version)) if versions else None
 
     def transition_model(self, model_name: str, version: int, stage: str, description=None):
-        return self.client.transition_model_version_stage(model_name, str(version), stage)
+        previous_stage = self._safe_get_stage(model_name, version)
+        if previous_stage is None:
+            return None
+        if previous_stage.lower() == str(stage).lower():
+            warnings.warn(f"Model {model_name} version {version} is already in stage {stage}")
+            return self.client.get_model_version(model_name, str(version))
+        self._print(
+            f"Transitioning model {model_name} version {version} from {previous_stage} to {stage}"
+        )
+        model_version = self.client.transition_model_version_stage(model_name, str(version), stage)
+        registered_description = self.client.get_registered_model(model_name).description or ""
+        version_description = (
+            self.client.get_model_version(model_name, str(version)).description or ""
+        )
+        entry = "## **Transition:**\n"
+        entry += f"### Version {model_version.version} from {previous_stage} to {model_version.current_stage}\n"
+        entry += self._get_author_and_date()
+        entry += self._generate_description(description)
+        self.client.update_registered_model(model_name, registered_description + entry)
+        self.client.update_model_version(model_name, model_version.version, version_description + entry)
+        return model_version
 
     def download_model(self, model_name: str, version: int, output_path: str):
         import mlflow
@@ -84,7 +143,66 @@ class MlflowModelManager(AbstractModelManager):
         )
 
     def delete_model(self, model_name: str, version: int, description=None):
+        model_stage = self._safe_get_stage(model_name, version)
+        if model_stage is None:
+            return
+        self._print(f"Deleting model {model_name} version {version}")
         self.client.delete_model_version(model_name, str(version))
+        registered_description = self.client.get_registered_model(model_name).description or ""
+        entry = "## **Deletion:**\n"
+        entry += f"### Version {version} from stage: {model_stage}\n"
+        entry += self._get_author_and_date()
+        entry += self._generate_description(description)
+        self.client.update_registered_model(model_name, registered_description + entry)
+
+    def register_best_models(
+        self,
+        experiment_name: str,
+        models_info: Dict[str, Dict[str, Any]],
+        metric: str = "Test/cumulative_reward",
+        mode: str = "max",
+    ) -> Optional[Dict[str, Any]]:
+        """Register, for every model in ``models_info`` (``{key: {path, name,
+        description, tags}}``), the version logged by the experiment's best
+        run according to ``metric`` (reference mlflow.py:214-281)."""
+        if mode not in ("max", "min"):
+            raise ValueError(f"Mode must be either 'max' or 'min', got {mode}")
+        experiment = self.client.get_experiment_by_name(experiment_name)
+        if experiment is None:
+            self._print(f"No experiment named {experiment_name}")
+            return None
+        runs = self.client.search_runs(experiment_ids=[experiment.experiment_id])
+        if len(runs) == 0:
+            self._print(f"No runs found for experiment {experiment_name}")
+            return None
+
+        models_path = [v["path"] for v in models_info.values()]
+        best_run = None
+        best_run_artifacts: Optional[set] = None
+        sign = 1.0 if mode == "max" else -1.0
+        for run in runs:
+            run_artifacts = [
+                x.path for x in self.client.list_artifacts(run.info.run_id) if x.path in models_path
+            ]
+            if len(run_artifacts) == 0 or run.data.metrics.get(metric) is None:
+                continue
+            if best_run is None or sign * run.data.metrics[metric] > sign * best_run.data.metrics[metric]:
+                best_run = run
+                best_run_artifacts = set(run_artifacts)
+        if best_run is None:
+            self._print(f"No runs found for experiment {experiment_name} with the given metric")
+            return None
+
+        models_version = {}
+        for k, v in models_info.items():
+            if v["path"] in best_run_artifacts:
+                models_version[k] = self.register_model(
+                    model_location=f"runs:/{best_run.info.run_id}/{v['path']}",
+                    model_name=v["name"],
+                    description=v.get("description"),
+                    tags=v.get("tags"),
+                )
+        return models_version
 
 
 def log_models(

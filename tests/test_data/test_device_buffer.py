@@ -110,8 +110,16 @@ class TestDeviceBuffer:
         _fill(rb, 2)
         bad = _step(5)
         bad["surprise"] = np.zeros((1, 1, 1), np.float32)
-        with pytest.raises(KeyError, match="Unknown buffer key"):
+        with pytest.raises(KeyError, match="key set"):
             rb.add(bad)
+
+    def test_partial_key_add_raises(self):
+        # the single-dispatch whole-dict scatter makes partial writes illegal;
+        # the contract must fail loudly, not with a bare jit-time KeyError
+        rb = DeviceSequentialReplayBuffer(8, n_envs=1)
+        _fill(rb, 2)
+        with pytest.raises(KeyError, match="key set"):
+            rb.add({"terminated": np.zeros((1, 1, 1), np.float32)})
 
 
 def test_dreamer_v3_e2e_with_device_buffer():
@@ -341,3 +349,24 @@ def test_dreamer_v3_e2e_with_sharded_device_buffer():
     with mock.patch.object(sys, "argv", ["sheeprl_tpu"]):
         run(args)
     assert sorted(Path("logs").rglob("*.ckpt")), "no checkpoint written"
+
+
+def test_add_dtype_policy_and_nonarray_coercion():
+    """64-bit leaves narrow to 32-bit with a loud named warning (device
+    storage policy); non-array leaves are coerced via np.asarray."""
+    rb = DeviceSequentialReplayBuffer(8, n_envs=1)
+    rb.seed(0)
+    data = {
+        # list leaf deliberately FIRST: add()'s step-count probe must survive
+        # a non-array first entry
+        "terminated": [[[0.0]]],
+        "observations": np.zeros((1, 1, 2), np.float64),
+        "counts": np.zeros((1, 1, 1), np.int64),
+        "truncated": np.zeros((1, 1, 1), np.float32),
+        "is_first": np.zeros((1, 1, 1), np.float32),
+    }
+    with pytest.warns(UserWarning, match="DeviceSequentialReplayBuffer.*32-bit"):
+        rb.add(data)
+    assert rb._buf["observations"].dtype == np.float32
+    assert rb._buf["counts"].dtype == np.int32
+    assert rb._buf["terminated"].shape == (8, 1, 1)

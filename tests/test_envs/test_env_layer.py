@@ -207,3 +207,66 @@ def test_frame_stack_ring_matches_deque_oracle(num_stack, dilation):
         oracle.append(np.full((3, 4, 4), t % 256, np.uint8))
         expected = np.stack(list(oracle)[dilation - 1 :: dilation])
         np.testing.assert_array_equal(obs["rgb"], expected)
+
+
+@pytest.mark.parametrize("boundary_key", ["round_done", "stage_done", "game_done"])
+def test_frame_stack_diambra_round_boundary_refloods(boundary_key):
+    """A DIAMBRA round/stage/game boundary mid-episode must reflood the whole
+    window with the fresh scene's first frame (reference wrappers.py:160-171);
+    a boundary that coincides with done must NOT reflood, and non-DIAMBRA
+    infos are ignored."""
+    import gymnasium as gym
+
+    class BoundaryEnv(gym.Env):
+        observation_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 4, 4), np.uint8)})
+        action_space = gym.spaces.Discrete(2)
+
+        def __init__(self):
+            self._t = 0
+            self.next_infos = {}
+            self.next_done = False
+
+        def _obs(self):
+            return {"rgb": np.full((3, 4, 4), self._t % 256, np.uint8)}
+
+        def reset(self, *, seed=None, options=None):
+            self._t = 0
+            return self._obs(), {}
+
+        def step(self, action):
+            self._t += 1
+            return self._obs(), 0.0, self.next_done, False, dict(self.next_infos)
+
+    base = BoundaryEnv()
+    env = FrameStack(base, num_stack=3, cnn_keys=["rgb"], dilation=1)
+    env.reset()
+    for _ in range(3):
+        env.step(0)
+
+    flags = {"round_done": False, "stage_done": False, "game_done": False, boundary_key: True}
+
+    # non-DIAMBRA boundary infos are ignored: window keeps history
+    base.next_infos = dict(flags)
+    obs, *_ = env.step(0)
+    assert len(np.unique(obs["rgb"][:, 0, 0, 0])) > 1
+
+    # DIAMBRA boundary mid-episode: entire window becomes the new frame
+    base.next_infos = {"env_domain": "DIAMBRA", **flags}
+    obs, *_ = env.step(0)
+    newest = base._t % 256
+    np.testing.assert_array_equal(obs["rgb"], np.full((3, 3, 4, 4), newest, np.uint8))
+    # and the reflood persists in the ring for subsequent plain steps
+    base.next_infos = {}
+    obs, *_ = env.step(0)
+    assert (obs["rgb"][:2] == newest).all() and (obs["rgb"][2, 0, 0, 0] == base._t % 256)
+
+    # boundary coinciding with done must not reflood
+    env2 = FrameStack(BoundaryEnv(), num_stack=3, cnn_keys=["rgb"], dilation=1)
+    env2.reset()
+    inner2 = env2.env
+    for _ in range(3):
+        env2.step(0)
+    inner2.next_infos = {"env_domain": "DIAMBRA", **flags}
+    inner2.next_done = True
+    obs, *_ = env2.step(0)
+    assert len(np.unique(obs["rgb"][:, 0, 0, 0])) > 1

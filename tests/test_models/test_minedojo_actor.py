@@ -1,0 +1,206 @@
+"""MinedojoActor hierarchical action masking (sheeprl_tpu/algos/dreamer_v3/
+agent.py), mirroring reference agent.py:848-932: head 0 masked by
+``mask_action_type``; head 1 by ``mask_craft_smelt`` only when the sampled
+action type is 15 (craft); head 2 by ``mask_equip_place`` for types 16/17 and
+``mask_destroy`` for 18.  Masked categories must never be sampled and their
+(unimix-transformed) logits must be -inf."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.algos.dreamer_v3.agent import Actor, MinedojoActor
+
+ACTIONS_DIM = (19, 4, 6)
+LATENT = 8
+
+
+def _make(cls=MinedojoActor):
+    actor = cls(
+        latent_state_size=LATENT,
+        actions_dim=ACTIONS_DIM,
+        is_continuous=False,
+        distribution="discrete",
+        dense_units=16,
+        mlp_layers=1,
+        unimix=0.01,
+    )
+    params = actor.init(jax.random.PRNGKey(0), jnp.zeros((1, LATENT)))
+    return actor, params
+
+
+def _mask(action_type=None, craft=None, equip_place=None, destroy=None):
+    def onehot_allow(n, allowed):
+        m = np.zeros((1, n), bool)
+        m[0, list(allowed)] = True
+        return m
+
+    return {
+        "mask_action_type": jnp.asarray(
+            onehot_allow(19, action_type if action_type is not None else range(19))
+        ),
+        "mask_craft_smelt": jnp.asarray(onehot_allow(4, craft if craft is not None else range(4))),
+        "mask_equip_place": jnp.asarray(
+            onehot_allow(6, equip_place if equip_place is not None else range(6))
+        ),
+        "mask_destroy": jnp.asarray(onehot_allow(6, destroy if destroy is not None else range(6))),
+    }
+
+
+def _heads(actions):
+    a = np.asarray(actions)
+    i0 = int(np.argmax(a[..., :19], axis=-1).squeeze())
+    i1 = int(np.argmax(a[..., 19:23], axis=-1).squeeze())
+    i2 = int(np.argmax(a[..., 23:], axis=-1).squeeze())
+    return i0, i1, i2
+
+
+def _sample_many(actor, params, mask, n=40, greedy=False):
+    state = jnp.ones((1, LATENT))
+    outs = []
+    for s in range(n):
+        key = jax.random.PRNGKey(s)
+        outs.append(_heads(actor.apply(params, state, key, greedy, mask, method="act")))
+    return outs
+
+
+def test_action_type_mask_restricts_head0():
+    actor, params = _make()
+    mask = _mask(action_type=[0, 3, 7])
+    for i0, _, _ in _sample_many(actor, params, mask):
+        assert i0 in (0, 3, 7)
+
+
+def test_craft_mask_applies_only_when_craft_sampled():
+    actor, params = _make()
+    # force functional action = 15 (craft): head 1 must obey mask_craft_smelt
+    mask = _mask(action_type=[15], craft=[2])
+    for i0, i1, _ in _sample_many(actor, params, mask):
+        assert i0 == 15 and i1 == 2
+    # non-craft functional action: head 1 is unconstrained by mask_craft_smelt
+    mask = _mask(action_type=[0], craft=[2])
+    seen = {i1 for _, i1, _ in _sample_many(actor, params, mask, n=80)}
+    assert not seen <= {2}, "craft mask must not constrain head 1 when action type != 15"
+
+
+@pytest.mark.parametrize("equip_or_place", [16, 17])
+def test_equip_place_mask(equip_or_place):
+    actor, params = _make()
+    mask = _mask(action_type=[equip_or_place], equip_place=[1, 4])
+    for i0, _, i2 in _sample_many(actor, params, mask):
+        assert i0 == equip_or_place and i2 in (1, 4)
+
+
+def test_destroy_mask():
+    actor, params = _make()
+    mask = _mask(action_type=[18], destroy=[5], equip_place=[0])
+    for i0, _, i2 in _sample_many(actor, params, mask):
+        assert i0 == 18 and i2 == 5  # destroy mask governs, equip mask ignored
+
+
+def test_masked_logit_values_are_neg_inf_after_unimix():
+    """Masking must zero the probability exactly (not just shrink it): with
+    unimix smoothing alone every category keeps probability >= unimix/K, so a
+    surviving smoothed floor would betray masking-before-unimix."""
+    actor, params = _make()
+    mask = _mask(action_type=[15], craft=[0, 1])
+    state = jnp.ones((1, LATENT))
+    pre = actor.apply(params, state)
+    from sheeprl_tpu.algos.dreamer_v3.agent import _unimix
+
+    logits0 = _unimix(pre[0], 19, 0.01)
+    masked0 = actor._masked_logits_for_head(0, logits0, None, mask)
+    np.testing.assert_array_equal(
+        np.isneginf(np.asarray(masked0)).squeeze(), ~np.asarray(mask["mask_action_type"]).squeeze()
+    )
+    # unmasked entries keep their unimix values untouched (renormalization is
+    # the softmax's job, matching the reference's logits[~mask] = -inf)
+    keep = np.asarray(mask["mask_action_type"]).squeeze()
+    np.testing.assert_allclose(
+        np.asarray(masked0).squeeze()[keep], np.asarray(logits0).squeeze()[keep]
+    )
+
+
+def test_greedy_respects_masks():
+    actor, params = _make()
+    mask = _mask(action_type=[16], equip_place=[3])
+    for i0, _, i2 in _sample_many(actor, params, mask, n=3, greedy=True):
+        assert i0 == 16 and i2 == 3
+
+
+def test_no_mask_matches_base_actor_sampling():
+    """With mask=None the MinedojoActor must behave exactly like Actor (same
+    params pytree shape, same sampling path)."""
+    actor, params = _make()
+    base, _ = _make(Actor)
+    state = jnp.ones((1, LATENT))
+    key = jax.random.PRNGKey(7)
+    ours = actor.apply(params, state, key, False, None, method="act")
+    theirs = base.apply(params, state, key, False, None, method="act")
+    np.testing.assert_array_equal(np.asarray(ours), np.asarray(theirs))
+
+
+def test_player_end_to_end_with_masks():
+    """Stub-space dry run: build_agent with algo.actor.cls=MinedojoActor and
+    drive PlayerDV3.get_actions through the jitted step with a mask dict."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3, build_agent
+    from sheeprl_tpu.config import compose
+
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.actor.cls=sheeprl_tpu.algos.dreamer_v3.agent.MinedojoActor",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+            "env.capture_video=False",
+            "metric.log_level=0",
+        ]
+    )
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    wm_def, actor_def, _, params = build_agent(None, ACTIONS_DIM, False, cfg, obs_space)
+    assert isinstance(actor_def, MinedojoActor)
+    player = PlayerDV3(wm_def, actor_def, ACTIONS_DIM, num_envs=1)
+    player.init_states(params["world_model"])
+    obs = {"rgb": jnp.zeros((1, 3, 64, 64), jnp.float32)}
+    mask = _mask(action_type=[15], craft=[1])
+    actions = player.get_actions(
+        params["world_model"], params["actor"], obs, jax.random.PRNGKey(0), mask=mask
+    )
+    i0, i1, _ = _heads(actions)
+    assert i0 == 15 and i1 == 1
+
+
+def test_actor_cls_rejects_non_actor():
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.config import compose
+
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.actor.cls=sheeprl_tpu.algos.dreamer_v3.agent.Critic",
+            "env.capture_video=False",
+            "metric.log_level=0",
+        ]
+    )
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    with pytest.raises(ValueError, match="Actor subclass"):
+        build_agent(None, ACTIONS_DIM, False, cfg, obs_space)

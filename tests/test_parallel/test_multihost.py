@@ -1,0 +1,53 @@
+"""Multi-host (DCN) execution: two OS processes, each with 2 virtual CPU
+devices, rendezvous through ``jax.distributed`` on a localhost coordinator and
+run the Runtime's cross-process collectives plus one sharded PPO gradient
+step over the global 4-device mesh (VERDICT r3 item 6; reference contracts:
+sheeprl/utils/logger.py:78-114 log-dir broadcast,
+sheeprl/algos/ppo/ppo.py:60-96 DDP all-reduce)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_runtime_collectives_and_sharded_ppo_step():
+    port = _free_port()
+    nproc = 2
+    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(filter(None, [repo_root, env.get("PYTHONPATH", "")]))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), str(nproc), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out[-4000:]}"
+        assert f"MULTIHOST_OK rank={pid} world=4" in out, out[-2000:]

@@ -1,0 +1,179 @@
+"""MlflowModelManager against a mocked client: changelog-keeping register /
+transition / delete and ``register_best_models`` best-run selection
+(reference /root/reference/sheeprl/utils/mlflow.py:75-281)."""
+
+from __future__ import annotations
+
+import sys
+import types
+from types import SimpleNamespace
+
+import pytest
+
+import sheeprl_tpu.utils.mlflow as mlflow_mod
+
+
+class FakeClient:
+    def __init__(self):
+        self.registered = {}  # name -> description
+        self.versions = {}  # (name, version) -> SimpleNamespace
+        self.next_version = {}  # name -> int
+        self.experiments = {}  # name -> id
+        self.runs = []  # list of run objects
+        self.artifacts = {}  # run_id -> [paths]
+        self.deleted = []
+
+    # registry ---------------------------------------------------------------
+    def get_registered_model(self, name):
+        return SimpleNamespace(name=name, description=self.registered.get(name, ""))
+
+    def update_registered_model(self, name, description):
+        self.registered[name] = description
+
+    def get_model_version(self, name, version):
+        return self.versions[(name, str(version))]
+
+    def update_model_version(self, name, version, description):
+        self.versions[(name, str(version))].description = description
+
+    def transition_model_version_stage(self, name, version, stage):
+        mv = self.versions[(name, str(version))]
+        mv.current_stage = stage
+        return mv
+
+    def delete_model_version(self, name, version):
+        self.deleted.append((name, str(version)))
+        del self.versions[(name, str(version))]
+
+    def search_model_versions(self, query):
+        name = query.split("'")[1]
+        return [v for (n, _), v in self.versions.items() if n == name]
+
+    # experiments/runs -------------------------------------------------------
+    def get_experiment_by_name(self, name):
+        if name not in self.experiments:
+            return None
+        return SimpleNamespace(experiment_id=self.experiments[name])
+
+    def search_runs(self, experiment_ids):
+        return [r for r in self.runs if r.info.experiment_id in experiment_ids]
+
+    def list_artifacts(self, run_id):
+        return [SimpleNamespace(path=p) for p in self.artifacts.get(run_id, [])]
+
+    # used by the fake mlflow.register_model ---------------------------------
+    def _register(self, name):
+        v = self.next_version.get(name, 0) + 1
+        self.next_version[name] = v
+        mv = SimpleNamespace(
+            name=name, version=str(v), current_stage="None", description=""
+        )
+        self.versions[(name, str(v))] = mv
+        self.registered.setdefault(name, "")
+        return mv
+
+
+@pytest.fixture()
+def manager(monkeypatch):
+    client = FakeClient()
+    fake_mlflow = types.ModuleType("mlflow")
+    fake_mlflow.set_tracking_uri = lambda uri: None
+    fake_mlflow.register_model = lambda model_uri, name, tags=None: client._register(name)
+    fake_tracking = types.ModuleType("mlflow.tracking")
+    fake_tracking.MlflowClient = lambda: client
+    fake_mlflow.tracking = fake_tracking
+    monkeypatch.setitem(sys.modules, "mlflow", fake_mlflow)
+    monkeypatch.setitem(sys.modules, "mlflow.tracking", fake_tracking)
+    monkeypatch.setattr(mlflow_mod, "_IS_MLFLOW_AVAILABLE", True)
+    runtime = SimpleNamespace(print=lambda *a: None)
+    mgr = mlflow_mod.MlflowModelManager(runtime, tracking_uri="fake://")
+    return mgr, client
+
+
+def test_register_model_keeps_changelog(manager):
+    mgr, client = manager
+    mv = mgr.register_model("runs:/abc/agent", "my-model", description="first drop")
+    assert mv.version == "1"
+    assert client.registered["my-model"].startswith("# MODEL CHANGELOG")
+    assert "first drop" in client.registered["my-model"]
+    assert "**Author**" in client.versions[("my-model", "1")].description
+    # second version appends without re-adding the header
+    mgr.register_model("runs:/abc/agent", "my-model")
+    assert client.registered["my-model"].count("# MODEL CHANGELOG") == 1
+    assert "## **Version 2**" in client.registered["my-model"]
+
+
+def test_transition_model_guards_and_logs(manager):
+    mgr, client = manager
+    mgr.register_model("runs:/abc/agent", "m")
+    mv = mgr.transition_model("m", 1, "staging", description="promote")
+    assert mv.current_stage == "staging"
+    assert "from None to staging" in client.registered["m"]
+    # same-stage transition warns and leaves the changelog alone
+    before = client.registered["m"]
+    with pytest.warns(UserWarning, match="already in stage"):
+        mgr.transition_model("m", 1, "staging")
+    assert client.registered["m"] == before
+    # unknown version warns, returns None
+    with pytest.warns(UserWarning, match="not found"):
+        assert mgr.transition_model("m", 99, "production") is None
+
+
+def test_delete_model_records_stage(manager):
+    mgr, client = manager
+    mgr.register_model("runs:/abc/agent", "m")
+    mgr.transition_model("m", 1, "staging")
+    mgr.delete_model("m", 1, description="obsolete")
+    assert client.deleted == [("m", "1")]
+    assert "## **Deletion:**" in client.registered["m"]
+    assert "from stage: staging" in client.registered["m"]
+
+
+def test_get_latest_version(manager):
+    mgr, client = manager
+    assert mgr.get_latest_version("m") is None
+    mgr.register_model("runs:/abc/agent", "m")
+    mgr.register_model("runs:/abc/agent", "m")
+    assert mgr.get_latest_version("m").version == "2"
+
+
+def _add_run(client, run_id, experiment_id, metrics, artifacts):
+    client.runs.append(
+        SimpleNamespace(
+            info=SimpleNamespace(run_id=run_id, experiment_id=experiment_id),
+            data=SimpleNamespace(metrics=metrics),
+        )
+    )
+    client.artifacts[run_id] = artifacts
+
+
+def test_register_best_models_picks_best_run(manager):
+    mgr, client = manager
+    client.experiments["exp"] = "e1"
+    _add_run(client, "r_low", "e1", {"Test/cumulative_reward": 10.0}, ["agent"])
+    _add_run(client, "r_best", "e1", {"Test/cumulative_reward": 99.0}, ["agent", "critic"])
+    _add_run(client, "r_nometric", "e1", {}, ["agent"])
+    _add_run(client, "r_noartifact", "e1", {"Test/cumulative_reward": 500.0}, [])
+    models_info = {
+        "agent": {"path": "agent", "name": "best-agent", "description": "d", "tags": None},
+        "critic": {"path": "critic", "name": "best-critic"},
+        "absent": {"path": "nowhere", "name": "never"},
+    }
+    out = mgr.register_best_models("exp", models_info)
+    assert set(out) == {"agent", "critic"}
+    assert out["agent"].name == "best-agent"
+    # min mode selects the lowest-metric run, which logged only "agent"
+    out = mgr.register_best_models("exp", models_info, mode="min")
+    assert set(out) == {"agent"}
+
+
+def test_register_best_models_edge_cases(manager):
+    mgr, client = manager
+    with pytest.raises(ValueError, match="max.*min|min.*max"):
+        mgr.register_best_models("exp", {}, mode="median")
+    assert mgr.register_best_models("missing", {}) is None
+    client.experiments["empty"] = "e9"
+    assert mgr.register_best_models("empty", {}) is None
+    client.experiments["nometric"] = "e10"
+    _add_run(client, "r1", "e10", {}, ["agent"])
+    assert mgr.register_best_models("nometric", {"agent": {"path": "agent", "name": "n"}}) is None

@@ -1,0 +1,96 @@
+"""DV3 train-step performance study on the real chip (VERDICT r3 items 2-3).
+
+Prints one JSON line per experiment:
+- tunnel latencies: dispatch overhead + blocking value-fetch RTT (the e2e
+  analysis in PERF.md is built on these)
+- DV3-S compute/MFU at batch 16/32/64 (weight-streaming amortization study)
+- DV3-XL compute/MFU at batch 16 (the north-star config)
+
+Usage: python tools/perf_study.py [--sizes S,XL] [--batches 16,32,64]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import measure_compute  # noqa: E402
+
+
+def measure_tunnel():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = f(jnp.zeros((256,)))
+    np.asarray(x)
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(100):
+        y = f(y)
+    np.asarray(y)
+    dispatch_ms = (time.perf_counter() - t0) * 10.0
+    t0 = time.perf_counter()
+    for _ in range(20):
+        x = f(x)
+        np.asarray(x)
+    rtt_ms = (time.perf_counter() - t0) * 50.0
+    return {
+        "experiment": "tunnel_latency",
+        "dispatch_ms": round(dispatch_ms, 3),
+        "fetch_rtt_ms": round(rtt_ms, 2),
+    }
+
+
+PHASE_EXPERIMENTS = {
+    # Phase isolation by config deltas vs the base (T=64, H=15, pixel obs):
+    # the difference between base and each variant prices one phase.
+    "horizon_1": ["algo.horizon=1"],  # base - this = imagination+actor/critic scan
+    "seq_8": ["algo.per_rank_sequence_length=8"],  # (base - this)/56*64 ~ RSSM scan
+    "vector_obs": [  # base - this = conv encoder+decoder stack
+        "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.mlp_keys.decoder=[state]",
+    ],
+}
+
+
+def main() -> None:
+    import os
+
+    sizes = os.environ.get("PERF_SIZES", "S,XL").split(",")
+    batches = [int(b) for b in os.environ.get("PERF_BATCHES", "16,32,64").split(",")]
+    precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
+    phases = os.environ.get("PERF_PHASES", "0") == "1"
+
+    print(json.dumps(measure_tunnel()), flush=True)
+    for size in sizes:
+        for b in batches if size == "S" else [16]:
+            res = measure_compute(precision, size=size, batch_size=b, measure_steps=60)
+            res = {
+                "experiment": f"dreamer_v3_{size}_b{b}",
+                "grad_steps_per_sec": res.pop("grad_steps_per_sec_compute"),
+                **res,
+                "samples_per_sec": round(res["step_ms"] and b / (res["step_ms"] / 1e3), 1),
+            }
+            print(json.dumps(res), flush=True)
+        if phases:
+            for name, overrides in PHASE_EXPERIMENTS.items():
+                res = measure_compute(
+                    precision, size=size, batch_size=16, measure_steps=60, extra_overrides=overrides
+                )
+                res = {
+                    "experiment": f"dreamer_v3_{size}_b16_{name}",
+                    "grad_steps_per_sec": res.pop("grad_steps_per_sec_compute"),
+                    **res,
+                }
+                print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()
