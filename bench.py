@@ -208,7 +208,7 @@ def measure_compute(
     return out
 
 
-def measure_e2e(precision: str):
+def measure_e2e(precision: str, num_envs: int = 1):
     """End-to-end DV3-S loop on a dummy pixel env: player inference + env
     step + replay add/sample + one gradient step per policy step
     (replay_ratio 1) — BASELINE.md §C's metric, like the reference's 14 h
@@ -237,12 +237,11 @@ def measure_e2e(precision: str):
         "algo.cnn_keys.decoder=[rgb]",
         "algo.mlp_keys.encoder=[]",
         "algo.mlp_keys.decoder=[]",
-        "env.num_envs=1",
+        f"env.num_envs={num_envs}",
         "env.capture_video=False",
         "metric.log_level=0",
         f"fabric.precision={precision}",
     ]
-    num_envs = 1
     env_cfg = compose(overrides)
     envs = vectorized_env(
         [make_env(env_cfg, 42 + i, 0, None, "bench", vector_env_idx=i) for i in range(num_envs)],
@@ -321,12 +320,14 @@ def measure_e2e(precision: str):
             rb.add(step_data)
             step_data, obs = fetch_and_step_envs(step_data, obs)
 
-        # in-HBM sequence gather + 1 gradient step (ratio 1)
-        (staged,) = rb.sample(B, sequence_length=T, n_samples=1)
-        batch = normalize_staged(staged, obs_keys)
-        params, opt_states, moments_state, metrics = train_step(
-            params, opt_states, moments_state, batch, k_train, jnp.float32(0.02)
-        )
+        # in-HBM sequence gather + ratio-1 gradient steps (one per policy
+        # step, so num_envs of them per iteration)
+        for staged in rb.sample(B, sequence_length=T, n_samples=num_envs):
+            batch = normalize_staged(staged, obs_keys)
+            k_train, sub = jax.random.split(k_train)
+            params, opt_states, moments_state, metrics = train_step(
+                params, opt_states, moments_state, batch, sub, jnp.float32(0.02)
+            )
 
         if pipelined:
             step_data, obs = fetch_and_step_envs(step_data, obs)
@@ -347,7 +348,7 @@ def measure_e2e(precision: str):
             )
         _ = np.asarray(metrics)
         elapsed = time.perf_counter() - t0
-        results[f"grad_steps_per_sec_e2e_{mode}"] = round(E2E_MEASURE_ITERS / elapsed, 3)
+        results[f"grad_steps_per_sec_e2e_{mode}"] = round(E2E_MEASURE_ITERS * num_envs / elapsed, 3)
     envs.close()
     return {
         "grad_steps_per_sec_e2e": results["grad_steps_per_sec_e2e_pipelined"],
@@ -356,10 +357,37 @@ def measure_e2e(precision: str):
     }
 
 
+def measure_fetch_rtt():
+    """Blocking value-fetch round trip of the device link (through the axon
+    tunnel this is ~90-110 ms and dominates the e2e loop's critical path; on
+    a TPU-VM host it is sub-ms — see PERF.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = f(jnp.zeros((256,)))
+    np.asarray(x)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        x = f(x)
+        np.asarray(x)
+    return round((time.perf_counter() - t0) * 100.0, 1)
+
+
 def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
+    fetch_rtt_ms = measure_fetch_rtt()
     compute = measure_compute(precision)
     e2e = measure_e2e(precision)
+    # 4-env variant: one action fetch serves 4 policy steps, amortizing the
+    # device-link round trip that bounds the 1-env loop (PERF.md §2); still
+    # ratio 1 — four gradient steps per iteration
+    e2e_4env = measure_e2e(precision, num_envs=4)
+    # north-star config (BASELINE.md §C): XL single-chip compute + MFU, at the
+    # reference batch (16) and at the MXU-saturating batch (64)
+    xl = measure_compute(precision, size="XL", batch_size=16, measure_steps=40)
+    xl_b64 = measure_compute(precision, size="XL", batch_size=64, measure_steps=25)
     value = e2e["grad_steps_per_sec_e2e"]
     print(
         json.dumps(
@@ -370,8 +398,17 @@ def main() -> None:
                 "vs_baseline": round(value / BASELINE_E2E_GRAD_STEPS_PER_SEC, 3),
                 "baseline": "reference DV3-S Atari-100K: 25k grad steps / 14 h on RTX-3080 = 0.496/s e2e",
                 "precision": precision,
+                "fetch_rtt_ms": fetch_rtt_ms,
                 **{k: v for k, v in e2e.items() if k != "grad_steps_per_sec_e2e"},
+                "grad_steps_per_sec_e2e_4env": e2e_4env["grad_steps_per_sec_e2e_pipelined"],
+                "grad_steps_per_sec_e2e_4env_serialized": e2e_4env["grad_steps_per_sec_e2e_serialized"],
                 **compute,
+                "dreamer_v3_XL": {
+                    k: v for k, v in xl.items() if k not in ("flops_per_step", "device_kind")
+                },
+                "dreamer_v3_XL_b64": {
+                    k: v for k, v in xl_b64.items() if k not in ("flops_per_step", "device_kind")
+                },
             }
         )
     )

@@ -30,6 +30,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
     PlayerDV3,
     RecurrentModel,
     trunc_normal_init,
+    resolve_actor_cls,
 )
 
 PlayerDV1 = PlayerDV3
@@ -325,7 +326,8 @@ def build_agent(
         dense_act="elu",
         cnn_act="relu",
     )
-    actor_def = Actor(
+    # reference dv1 agent.py:472 / dv2 agent.py:1019: actor class from config
+    actor_def = resolve_actor_cls(cfg.algo.actor)(
         latent_state_size=latent_state_size,
         actions_dim=tuple(int(a) for a in actions_dim),
         is_continuous=is_continuous,

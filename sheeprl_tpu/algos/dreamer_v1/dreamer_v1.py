@@ -34,7 +34,7 @@ from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import Bernoulli
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches, local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -458,7 +458,7 @@ def main(runtime, cfg):
                 per_rank_gradient_steps = 1
             if per_rank_gradient_steps > 0:
                 local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * world_size,
+                    local_sample_size(cfg.algo.per_rank_batch_size * world_size),
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )

@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (  # noqa: F401
     PlayerDV3,
     WorldModel,
     compute_stochastic_state,
+    resolve_actor_cls,
 )
 
 PlayerDV2 = PlayerDV3  # same stateful env-interaction machinery (reference agent.py:735-838)
@@ -93,7 +94,8 @@ def build_agent(
         symlog_inputs=False,
         hafner_heads=False,
     )
-    actor_def = Actor(
+    # reference dv1 agent.py:472 / dv2 agent.py:1019: actor class from config
+    actor_def = resolve_actor_cls(cfg.algo.actor)(
         latent_state_size=latent_state_size,
         actions_dim=tuple(int(a) for a in actions_dim),
         is_continuous=is_continuous,

@@ -799,6 +799,21 @@ class Critic(nn.Module):
         return nn.Dense(self.bins, kernel_init=init)(x)
 
 
+def resolve_actor_cls(actor_cfg) -> type:
+    """``cfg.algo.actor.cls`` selects the actor class (reference
+    agent.py:1136-1141 via ``hydra.utils.get_class``); exp overlays pick
+    ``MinedojoActor`` for MineDojo.  Shared by the DV1/DV2/DV3 (and therefore
+    P2E/JEPA) ``build_agent``s."""
+    if not actor_cfg.get("cls"):
+        return Actor
+    from sheeprl_tpu.config import get_callable
+
+    actor_cls = get_callable(actor_cfg.cls)
+    if not (isinstance(actor_cls, type) and issubclass(actor_cls, Actor)):
+        raise ValueError(f"algo.actor.cls must name an Actor subclass, got {actor_cfg.cls!r}")
+    return actor_cls
+
+
 def build_agent(
     runtime,
     actions_dim: Sequence[int],
@@ -860,16 +875,7 @@ def build_agent(
         decoupled_rssm=wm_cfg.decoupled_rssm,
         fused_gru=wm_cfg.recurrent_model.get("fused_kernel", False),
     )
-    # cfg.algo.actor.cls selects the actor class (reference agent.py:1136-1141
-    # via hydra.utils.get_class); exp overlays pick MinedojoActor for MineDojo
-    actor_cls = Actor
-    if actor_cfg.get("cls"):
-        from sheeprl_tpu.config import get_callable
-
-        actor_cls = get_callable(actor_cfg.cls)
-        if not (isinstance(actor_cls, type) and issubclass(actor_cls, Actor)):
-            raise ValueError(f"algo.actor.cls must name an Actor subclass, got {actor_cfg.cls!r}")
-    actor_def = actor_cls(
+    actor_def = resolve_actor_cls(actor_cfg)(
         latent_state_size=latent_state_size,
         actions_dim=tuple(int(a) for a in actions_dim),
         is_continuous=is_continuous,

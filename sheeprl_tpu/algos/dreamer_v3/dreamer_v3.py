@@ -54,7 +54,7 @@ from sheeprl_tpu.ops.distributions import (
     TwoHotEncodingDistribution,
 )
 from sheeprl_tpu.ops.numerics import compute_lambda_values
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches, local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -630,17 +630,27 @@ def _dreamer_main(
                 )
                 if use_device_buffer:
                     step_data["actions"] = jnp.reshape(actions_jnp, (1, num_envs, -1))
-                else:
+                elif np.any(rb.empty):
+                    # an empty (sub-)buffer cannot defer its first row past
+                    # the gradient-step sampling below (learning_starts=0
+                    # configs) — fall back to fetch-then-add for this step
                     actions = np.asarray(actions_jnp)
                     actions_jnp = None
                     real_actions = split_real_actions(actions)
                     step_data["actions"] = actions.reshape(1, num_envs, -1)
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if actions_jnp is None or use_device_buffer:
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
             if actions_jnp is not None:
                 # start the device->host copy NOW: it proceeds while the
                 # gradient steps below are dispatched, so the blocking fetch
                 # before `envs.step` finds the values already (or nearly)
-                # landed instead of paying the full tunnel round trip there
+                # landed instead of paying the full tunnel round trip there.
+                # Host-buffer mode pipelines the same way: the numpy write
+                # into the buffer needs the fetched values, so the add is
+                # deferred with the fetch — this iteration's gradient steps
+                # sample everything up to the PREVIOUS policy step (one row
+                # less than the device path; bounded, like the reset-row lag
+                # documented below).
                 actions_jnp.copy_to_host_async()
 
         # ---- dispatch this iteration's gradient steps ---------------------
@@ -661,7 +671,7 @@ def _dreamer_main(
             if per_rank_gradient_steps > 0:
                 has_trained = True
                 local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * world_size,
+                    local_sample_size(cfg.algo.per_rank_batch_size * world_size),
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )
@@ -694,6 +704,12 @@ def _dreamer_main(
             if actions_jnp is not None:
                 actions = np.asarray(actions_jnp)
                 real_actions = split_real_actions(actions)
+                if not use_device_buffer:
+                    # deferred host-buffer write (see the pipelining note
+                    # above): the fetched values land in the numpy ring here,
+                    # after this iteration's gradient steps were dispatched
+                    step_data["actions"] = actions.reshape(1, num_envs, -1)
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)

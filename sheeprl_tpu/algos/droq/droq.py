@@ -29,7 +29,7 @@ from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
-from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage
+from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage, local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -268,8 +268,8 @@ def main(runtime, cfg):
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
                     G = per_rank_gradient_steps
-                    sample = rb.sample(batch_size=batch_size * world_size, n_samples=G)
-                    actor_sample = rb.sample(batch_size=batch_size * world_size, n_samples=G)
+                    sample = rb.sample(batch_size=local_sample_size(batch_size * world_size), n_samples=G)
+                    actor_sample = rb.sample(batch_size=local_sample_size(batch_size * world_size), n_samples=G)
                     dp_mesh = runtime.mesh if world_size > 1 else None
                     data = stage(
                         {

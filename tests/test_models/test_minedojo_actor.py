@@ -204,3 +204,38 @@ def test_actor_cls_rejects_non_actor():
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
     with pytest.raises(ValueError, match="Actor subclass"):
         build_agent(None, ACTIONS_DIM, False, cfg, obs_space)
+
+
+@pytest.mark.parametrize("family", ["dreamer_v1", "dreamer_v2"])
+def test_actor_cls_selectable_in_dv1_dv2(family):
+    """DV1/DV2 build_agent honor cfg.algo.actor.cls like the reference
+    (dv1 agent.py:472, dv2 agent.py:1019)."""
+    import gymnasium as gym
+    from importlib import import_module
+
+    from sheeprl_tpu.config import compose
+
+    build = import_module(f"sheeprl_tpu.algos.{family}.agent").build_agent
+    cfg = compose(
+        [
+            f"exp={family}",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.actor.cls=sheeprl_tpu.algos.dreamer_v3.agent.MinedojoActor",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+            "env.capture_video=False",
+            "metric.log_level=0",
+        ]
+    )
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    actor_def = build(None, ACTIONS_DIM, False, cfg, obs_space)[1]
+    assert isinstance(actor_def, MinedojoActor)

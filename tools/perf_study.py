@@ -17,7 +17,7 @@ import time
 
 sys.path.insert(0, ".")
 
-from bench import measure_compute  # noqa: E402
+from bench import measure_compute, measure_fetch_rtt  # noqa: E402
 
 
 def measure_tunnel():
@@ -34,15 +34,10 @@ def measure_tunnel():
         y = f(y)
     np.asarray(y)
     dispatch_ms = (time.perf_counter() - t0) * 10.0
-    t0 = time.perf_counter()
-    for _ in range(20):
-        x = f(x)
-        np.asarray(x)
-    rtt_ms = (time.perf_counter() - t0) * 50.0
     return {
         "experiment": "tunnel_latency",
         "dispatch_ms": round(dispatch_ms, 3),
-        "fetch_rtt_ms": round(rtt_ms, 2),
+        "fetch_rtt_ms": measure_fetch_rtt(),
     }
 
 
