@@ -375,8 +375,34 @@ def measure_fetch_rtt():
     return round((time.perf_counter() - t0) * 100.0, 1)
 
 
+def _ensure_responsive_device() -> None:
+    """Probe device enumeration in a SUBPROCESS with a timeout: a hung remote
+    accelerator (the axon tunnel drops out for minutes at a time — PERF.md
+    §1) would otherwise block ``jax.devices()`` forever and hang the whole
+    bench.  On a dead tunnel, fall back to CPU so the harness still reports
+    a (clearly labeled) result."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True,
+            timeout=180,
+        )
+        if out.returncode == 0:
+            return
+    except subprocess.TimeoutExpired:
+        pass
+    print("WARNING: accelerator unresponsive; benching on CPU", file=sys.stderr)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
+    _ensure_responsive_device()
     fetch_rtt_ms = measure_fetch_rtt()
     compute = measure_compute(precision)
     e2e = measure_e2e(precision)

@@ -37,7 +37,7 @@ from sheeprl_tpu.ops.distributions import Bernoulli
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches, local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.metric import DeviceMetricsDrain, MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
@@ -371,6 +371,8 @@ def main(runtime, cfg):
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
 
+    metrics_drain = DeviceMetricsDrain()
+
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
 
@@ -458,7 +460,7 @@ def main(runtime, cfg):
                 per_rank_gradient_steps = 1
             if per_rank_gradient_steps > 0:
                 local_data = rb.sample(
-                    local_sample_size(cfg.algo.per_rank_batch_size * world_size),
+                    local_sample_size(cfg.algo.per_rank_batch_size * world_size, use_device_buffer),
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )
@@ -476,11 +478,10 @@ def main(runtime, cfg):
                         rng_key, train_key = jax.random.split(rng_key)
                         params, opt_states, metrics = train_step(params, opt_states, batch, train_key)
                     train_step_count += 1
-                metrics = np.asarray(metrics)
-                for name, value in zip(METRIC_ORDER, metrics):
-                    aggregator.update(name, float(value))
+                metrics_drain.append(metrics)
 
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
+            metrics_drain.flush_into(aggregator, METRIC_ORDER)
             metrics_dict = aggregator.compute()
             timers = timer.compute()
             if timers.get("Time/train_time", 0) > 0:

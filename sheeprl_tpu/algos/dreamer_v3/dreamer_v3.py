@@ -57,7 +57,7 @@ from sheeprl_tpu.ops.numerics import compute_lambda_values
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches, local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.metric import DeviceMetricsDrain, MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
@@ -87,6 +87,11 @@ def make_train_step(
     stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
     recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
     horizon = cfg.algo.horizon
+    # lax.scan unroll factor for the RSSM/imagination loops: unrolling
+    # amortizes per-iteration scan overhead (a measured ~6% step-time win at
+    # unroll=8 for the S size on v5e — PERF.md §4) at the cost of ~unroll x
+    # longer compiles, so it defaults to 1 and is a deploy-time knob
+    scan_unroll = int(cfg.algo.get("scan_unroll", 1))
     gamma = cfg.algo.gamma
     cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
     mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
@@ -128,7 +133,7 @@ def make_train_step(
             keys_t = jax.random.split(k_wm, T)
             init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
             _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
-                scan_body, init, (batch_actions, embedded, is_first, keys_t)
+                scan_body, init, (batch_actions, embedded, is_first, keys_t), unroll=scan_unroll
             )
             latents = jnp.concatenate([posteriors, recurrents], axis=-1)
             recon = world_model_def.apply(wm_params, latents, method="decode")
@@ -205,7 +210,7 @@ def make_train_step(
                 return (prior, recurrent, actions), (latent, actions)
 
             keys_h = jax.random.split(k_img, horizon)
-            _, (latents_h, actions_h) = jax.lax.scan(img_body, (posteriors, recurrents, a0), keys_h)
+            _, (latents_h, actions_h) = jax.lax.scan(img_body, (posteriors, recurrents, a0), keys_h, unroll=scan_unroll)
             imagined_trajectories = jnp.concatenate([latent0[None], latents_h], axis=0)  # [H+1, TB, L]
             imagined_actions = jnp.concatenate([a0[None], actions_h], axis=0)
 
@@ -583,16 +588,7 @@ def _dreamer_main(
             start += d
         return np.stack(idxs, axis=-1)
 
-    # Train-step metrics are kept as device arrays and fetched in batches:
-    # through a remote-device tunnel a blocking value fetch costs a full
-    # round trip (~100 ms measured), so the hot loop never fetches per-step.
-    pending_metrics: list = []
-    metric_rows: list = []
-
-    def drain_metrics(force: bool = False) -> None:
-        if pending_metrics and (force or len(pending_metrics) >= 256):
-            metric_rows.extend(np.asarray(jnp.stack(pending_metrics)))
-            pending_metrics.clear()
+    metrics_drain = DeviceMetricsDrain()
 
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
@@ -671,7 +667,7 @@ def _dreamer_main(
             if per_rank_gradient_steps > 0:
                 has_trained = True
                 local_data = rb.sample(
-                    local_sample_size(cfg.algo.per_rank_batch_size * world_size),
+                    local_sample_size(cfg.algo.per_rank_batch_size * world_size, use_device_buffer),
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )
@@ -696,8 +692,7 @@ def _dreamer_main(
                         )
                         cumulative_grad_steps += 1
                     train_step_count += 1
-                pending_metrics.append(metrics)
-                drain_metrics()
+                metrics_drain.append(metrics)
 
         # ---- fetch the actions, step the envs (device keeps training) -----
         with timer("Time/env_interaction_time"):
@@ -776,11 +771,7 @@ def _dreamer_main(
 
         # ---- log (reference dreamer_v3.py:747-793) ------------------------
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
-            drain_metrics(force=True)
-            for row in metric_rows:
-                for name, value in zip(metric_order, row):
-                    aggregator.update(name, float(value))
-            metric_rows.clear()
+            metrics_drain.flush_into(aggregator, metric_order)
             metrics_dict = aggregator.compute()
             timers = timer.compute()
             if timers.get("Time/train_time", 0) > 0:

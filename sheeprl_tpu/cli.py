@@ -178,6 +178,15 @@ def run(args: Optional[Sequence[str]] = None):
     ``sys.argv[1:]`` — Hydra-style ``group=option``/``a.b=v`` overrides."""
     overrides = list(args if args is not None else sys.argv[1:])
     cfg = compose(overrides)
+    if cfg.fabric.get("accelerator") == "cpu":
+        # Force the CPU platform BEFORE any jax array op: site configuration
+        # may pre-register a remote accelerator plugin (e.g. a tunneled TPU)
+        # as the default backend, and merely selecting cpu devices later
+        # would still initialize — and block on — that backend for the
+        # default-placed arrays (PRNG keys, host scalars).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     n_threads = cfg.get("num_threads")
     if n_threads and int(n_threads) > 0:
         # host-side thread budget.  BLAS pools already initialized in this

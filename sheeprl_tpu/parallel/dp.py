@@ -86,14 +86,23 @@ def dp_jit(
     return jax.jit(mapped, donate_argnums=donate_argnums)
 
 
-def local_sample_size(global_batch: int) -> int:
-    """Rows THIS PROCESS must draw from its replay buffer so the staged
-    global batch is ``global_batch``.  Single-process (any number of local
-    devices): the full amount — ``stage`` shards it over the mesh.
-    Multi-process (DCN): each host contributes its block to
-    ``make_array_from_process_local_data``, so drawing the full global batch
+def local_sample_size(global_batch: int, device_resident: bool = False) -> int:
+    """Rows THIS PROCESS must draw from its replay buffer so the trained
+    global batch is ``global_batch``.
+
+    Host replay: single-process (any number of local devices) draws the full
+    amount — ``stage`` shards it over the mesh; multi-process (DCN) draws
+    ``global_batch / process_count`` because each host contributes its block
+    to ``make_array_from_process_local_data`` (drawing the full global batch
     per process would silently train at ``process_count``x the configured
-    batch (code-review finding, round 4)."""
+    batch — code-review finding, round 4).
+
+    Device-resident replay (``device_resident=True``): the HBM ring's
+    ``sample`` always takes the GLOBAL batch — its sharded gather divides
+    over the whole mesh internally — so the full amount is returned
+    regardless of process count."""
+    if device_resident:
+        return global_batch
     n = jax.process_count()
     if global_batch % n != 0:
         raise ValueError(

@@ -169,3 +169,37 @@ class RankIndependentMetricAggregator:
 
     def reset(self) -> None:
         self._aggregator.reset()
+
+
+class DeviceMetricsDrain:
+    """Batches train-step metric fetches: through a remote-device tunnel a
+    blocking value fetch costs a full round trip (~100 ms), so the Dreamer
+    hot loops never fetch per-iteration — device rows accumulate and are
+    pulled in one transfer every ``threshold`` steps or at the log boundary
+    (``flush_into``).  Shared by the dreamer_v1/v2/v3 loops."""
+
+    def __init__(self, threshold: int = 256):
+        self._threshold = threshold
+        self._pending: list = []
+        self._rows: list = []
+
+    def append(self, metrics) -> None:
+        self._pending.append(metrics)
+        if len(self._pending) >= self._threshold:
+            self._drain()
+
+    def _drain(self) -> None:
+        if self._pending:
+            import jax.numpy as jnp
+            import numpy as np
+
+            self._rows.extend(np.asarray(jnp.stack(self._pending)))
+            self._pending.clear()
+
+    def flush_into(self, aggregator: "MetricAggregator", metric_order) -> None:
+        """Fetch everything pending and feed the named aggregator."""
+        self._drain()
+        for row in self._rows:
+            for name, value in zip(metric_order, row):
+                aggregator.update(name, float(value))
+        self._rows.clear()
