@@ -375,7 +375,7 @@ def measure_fetch_rtt():
     return round((time.perf_counter() - t0) * 100.0, 1)
 
 
-def _ensure_responsive_device() -> None:
+def _ensure_responsive_device():
     """Probe device enumeration in a SUBPROCESS with a timeout: a hung remote
     accelerator (the axon tunnel drops out for minutes at a time — PERF.md
     §1) would otherwise block ``jax.devices()`` forever and hang the whole
@@ -384,6 +384,7 @@ def _ensure_responsive_device() -> None:
     import subprocess
     import sys
 
+    reason = None
     try:
         out = subprocess.run(
             [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
@@ -391,18 +392,23 @@ def _ensure_responsive_device() -> None:
             timeout=180,
         )
         if out.returncode == 0:
-            return
+            return None
+        reason = f"device enumeration failed (exit {out.returncode})"
     except subprocess.TimeoutExpired:
-        pass
-    print("WARNING: accelerator unresponsive; benching on CPU", file=sys.stderr)
+        reason = "accelerator link unresponsive (enumeration timed out)"
+    print(f"WARNING: {reason}; benching on CPU", file=sys.stderr)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    return (
+        f"{reason} at bench time - these are CPU-fallback numbers; "
+        "chip numbers are recorded in PERF.md and prior BENCH_r* files"
+    )
 
 
 def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
-    _ensure_responsive_device()
+    device_fallback = _ensure_responsive_device()
     fetch_rtt_ms = measure_fetch_rtt()
     compute = measure_compute(precision)
     e2e = measure_e2e(precision)
@@ -424,6 +430,7 @@ def main() -> None:
                 "vs_baseline": round(value / BASELINE_E2E_GRAD_STEPS_PER_SEC, 3),
                 "baseline": "reference DV3-S Atari-100K: 25k grad steps / 14 h on RTX-3080 = 0.496/s e2e",
                 "precision": precision,
+                **({"device_fallback": device_fallback} if device_fallback else {}),
                 "fetch_rtt_ms": fetch_rtt_ms,
                 **{k: v for k, v in e2e.items() if k != "grad_steps_per_sec_e2e"},
                 "grad_steps_per_sec_e2e_4env": e2e_4env["grad_steps_per_sec_e2e_pipelined"],
