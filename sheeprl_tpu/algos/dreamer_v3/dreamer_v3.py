@@ -88,9 +88,10 @@ def make_train_step(
     recurrent_size = wm_cfg.recurrent_model.recurrent_state_size
     horizon = cfg.algo.horizon
     # lax.scan unroll factor for the RSSM/imagination loops: unrolling
-    # amortizes per-iteration scan overhead (a measured ~6% step-time win at
-    # unroll=8 for the S size on v5e — PERF.md §4) at the cost of ~unroll x
-    # longer compiles, so it defaults to 1 and is a deploy-time knob
+    # amortizes per-iteration scan overhead (one S-size sweep on v5e showed
+    # ~6% at unroll=8, but follow-up A/Bs could not confirm it beyond tunnel
+    # noise — PERF.md §4) at the cost of ~unroll x longer compiles, so it
+    # defaults to 1 and is a deploy-time knob
     scan_unroll = int(cfg.algo.get("scan_unroll", 1))
     gamma = cfg.algo.gamma
     cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)

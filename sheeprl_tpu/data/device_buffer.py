@@ -158,10 +158,12 @@ class DeviceSequentialReplayBuffer:
         del validate_args
         # Coerce non-array leaves (lists/scalars) so .shape/.dtype are defined
         # everywhere below; array leaves (numpy or jax) pass through without a
-        # host round-trip.
-        for k, v in data.items():
-            if not isinstance(v, (np.ndarray, jax.Array)):
-                data[k] = np.asarray(v)
+        # host round-trip. Build a local dict rather than writing back into
+        # the caller's (callers reuse step_data across iterations).
+        data = {
+            k: v if isinstance(v, (np.ndarray, jax.Array)) else np.asarray(v)
+            for k, v in data.items()
+        }
         steps = next(iter(data.values())).shape[0]
         if steps != 1:
             raise ValueError(

@@ -153,6 +153,10 @@ class TPESampler:
             return {k: self.rng.choice(self.space[k]) for k in self.keys}
         ranked = sorted(self.observations, key=lambda o: o[1], reverse=True)
         n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        if len(ranked) > 1:
+            # keep good/bad disjoint: with a tiny study n_good can otherwise
+            # cover every observation, making the worst one penalize itself
+            n_good = min(n_good, len(ranked) - 1)
         good, bad = ranked[:n_good], ranked[n_good:] or ranked[-1:]
         l_dist = {k: self._smoothed([p[k] for p, _ in good], self.space[k]) for k in self.keys}
         g_dist = {k: self._smoothed([p[k] for p, _ in bad], self.space[k]) for k in self.keys}
