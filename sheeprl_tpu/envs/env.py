@@ -230,9 +230,19 @@ def vectorized_env(env_fns, sync: bool = True) -> gym.vector.VectorEnv:
     truncation bootstrapping, reference algos/ppo/ppo.py:287-306).
     """
     mode = gym.vector.AutoresetMode.SAME_STEP
-    if sync or len(env_fns) == 1:
+    if sync:
         return gym.vector.SyncVectorEnv(env_fns, autoreset_mode=mode)
-    return gym.vector.AsyncVectorEnv(env_fns, autoreset_mode=mode)
+    # spawn (not fork), even for a single env: env workers get a pristine
+    # runtime, which GL renderers require — creating a dm_control EGL
+    # context inside the jax/XLA host process segfaults (mesa EGL is not
+    # compatible with the loaded runtime state), and forking a threaded jax
+    # process is equally unsafe.  A lone async env is the supported way to
+    # run pixel DMC/mario alongside the device runtime.  Honoring sync_env
+    # verbatim (no single-env fast path) also matches the reference
+    # (sheeprl/algos/ppo/ppo.py:137 picks the class purely on cfg.env.sync_env);
+    # gymnasium's shared-memory obs transport keeps the per-step IPC cost
+    # far below a policy step.
+    return gym.vector.AsyncVectorEnv(env_fns, autoreset_mode=mode, context="spawn")
 
 
 def get_dummy_env(id: str) -> gym.Env:
