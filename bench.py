@@ -208,13 +208,28 @@ def measure_compute(
     return out
 
 
-def measure_e2e(precision: str, num_envs: int = 1):
-    """End-to-end DV3-S loop on a dummy pixel env: player inference + env
+def measure_e2e(
+    precision: str,
+    num_envs: int = 1,
+    size: str = "S",
+    batch_size: int = 16,
+    sequence_length: int = 64,
+    pixels: bool = True,
+    warmup_iters: int = E2E_WARMUP_ITERS,
+    measure_iters: int = E2E_MEASURE_ITERS,
+):
+    """End-to-end DV3 loop on a dummy env: player inference + env
     step + replay add/sample + one gradient step per policy step
     (replay_ratio 1) — BASELINE.md §C's metric, like the reference's 14 h
     Atari-100K wall clock.  Uses the HBM-resident replay buffer (the
     framework's intended TPU path): per-step host->device traffic is one
-    frame, and training batches are gathered inside HBM."""
+    frame, and training batches are gathered inside HBM.
+
+    The defaults are the flagship DV3-S pixel configuration; the CPU
+    fallback path shrinks the workload (``size``/``batch_size``/
+    ``sequence_length``/``pixels``/iteration counts) so the harness still
+    finishes inside the driver budget on a dead tunnel (VERDICT r4 weak #1).
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -226,17 +241,19 @@ def measure_e2e(precision: str, num_envs: int = 1):
 
     from sheeprl_tpu.config import compose
 
+    cnn = "[rgb]" if pixels else "[]"
+    mlp = "[]" if pixels else "[state]"
     overrides = [
         "exp=dreamer_v3",
         "env=dummy",
         "env.id=discrete_dummy",
-        "algo=dreamer_v3_S",
-        "algo.per_rank_batch_size=16",
-        "algo.per_rank_sequence_length=64",
-        "algo.cnn_keys.encoder=[rgb]",
-        "algo.cnn_keys.decoder=[rgb]",
-        "algo.mlp_keys.encoder=[]",
-        "algo.mlp_keys.decoder=[]",
+        f"algo=dreamer_v3_{size}",
+        f"algo.per_rank_batch_size={batch_size}",
+        f"algo.per_rank_sequence_length={sequence_length}",
+        f"algo.cnn_keys.encoder={cnn}",
+        f"algo.cnn_keys.decoder={cnn}",
+        f"algo.mlp_keys.encoder={mlp}",
+        f"algo.mlp_keys.decoder={mlp}",
         f"env.num_envs={num_envs}",
         "env.capture_video=False",
         "metric.log_level=0",
@@ -251,8 +268,10 @@ def measure_e2e(precision: str, num_envs: int = 1):
     cfg, wm_def, actor_def, _, params, opt_states, moments_state, train_step = _build(
         overrides, actions_dim=actions_dim
     )
-    obs_keys = ["rgb"]
-    rb = DeviceSequentialReplayBuffer(4096, n_envs=num_envs, obs_keys=("rgb",))
+    obs_keys = ["rgb"] if pixels else ["state"]
+    cnn_obs_keys = obs_keys if pixels else []
+    mlp_obs_keys = [] if pixels else obs_keys
+    rb = DeviceSequentialReplayBuffer(4096, n_envs=num_envs, obs_keys=tuple(obs_keys))
     player = PlayerDV3(wm_def, actor_def, actions_dim, num_envs)
     player.init_states(params["world_model"])
     key = jax.random.PRNGKey(0)
@@ -294,7 +313,7 @@ def measure_e2e(precision: str, num_envs: int = 1):
         action -> env.step -> train) for an apples-to-apples overlap number.
         """
         key, k_step, k_train = jax.random.split(key, 3)
-        torch_obs = prepare_obs(obs, cnn_keys=obs_keys, mlp_keys=[], num_envs=num_envs)
+        torch_obs = prepare_obs(obs, cnn_keys=cnn_obs_keys, mlp_keys=mlp_obs_keys, num_envs=num_envs)
         actions_jnp = player.get_actions(params["world_model"], params["actor"], torch_obs, k_step)
 
         def fetch_and_step_envs(step_data, obs):
@@ -335,20 +354,20 @@ def measure_e2e(precision: str, num_envs: int = 1):
 
     results = {}
     for mode, pipelined in (("serialized", False), ("pipelined", True)):
-        for _ in range(E2E_WARMUP_ITERS):
+        for _ in range(warmup_iters):
             params, opt_states, moments_state, step_data, obs, key, metrics = one_iter(
                 params, opt_states, moments_state, step_data, obs, key, pipelined
             )
         _ = np.asarray(metrics)  # value barrier (see measure_compute note)
 
         t0 = time.perf_counter()
-        for _ in range(E2E_MEASURE_ITERS):
+        for _ in range(measure_iters):
             params, opt_states, moments_state, step_data, obs, key, metrics = one_iter(
                 params, opt_states, moments_state, step_data, obs, key, pipelined
             )
         _ = np.asarray(metrics)
         elapsed = time.perf_counter() - t0
-        results[f"grad_steps_per_sec_e2e_{mode}"] = round(E2E_MEASURE_ITERS * num_envs / elapsed, 3)
+        results[f"grad_steps_per_sec_e2e_{mode}"] = round(measure_iters * num_envs / elapsed, 3)
     envs.close()
     return {
         "grad_steps_per_sec_e2e": results["grad_steps_per_sec_e2e_pipelined"],
@@ -406,45 +425,154 @@ def _ensure_responsive_device():
     )
 
 
-def main() -> None:
-    precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
-    device_fallback = _ensure_responsive_device()
-    fetch_rtt_ms = measure_fetch_rtt()
-    compute = measure_compute(precision)
-    e2e = measure_e2e(precision)
+def _run_cpu_fallback(record: dict, precision: str) -> None:
+    """Tiny workload for a dead accelerator link: DV3-XS, vector obs, short
+    sequences, few iterations — finishes in ~2 minutes on one CPU core.  The
+    WORKLOAD degrades, not just the label (VERDICT r4 weak #1: the full
+    pixel menu is hopeless on CPU and round 4's fallback timed out in the
+    driver).  ``value`` is a liveness number, explicitly not comparable to
+    the RTX-3080 baseline; chip numbers live in PERF.md and prior BENCH_r*."""
+    record["workload"] = (
+        "CPU-fallback liveness probe: DV3-XS, vector obs, batch 4 x seq 16, "
+        "20 iters — NOT the flagship pixel workload and not comparable to "
+        "the baseline; driver-verified chip numbers are in prior BENCH_r* "
+        "files and PERF.md"
+    )
+    # distinct metric name so cross-round aggregation by "metric" never mixes
+    # this liveness number into the DV3-S chip series
+    record["metric"] = "dreamer_v3_cpu_fallback_liveness_grad_steps_per_sec"
+    record["unit"] = "grad-steps/s end-to-end (CPU fallback: DV3-XS vector, batch 4 x seq 16, ratio 1)"
+    record["vs_baseline"] = None
+    record["baseline"] = None  # the RTX-3080 DV3-S baseline does not apply to the liveness workload
+    record["fetch_rtt_ms"] = measure_fetch_rtt()
+    e2e = measure_e2e(
+        precision,
+        size="XS",
+        batch_size=4,
+        sequence_length=16,
+        pixels=False,
+        warmup_iters=3,
+        measure_iters=20,
+    )
+    record["value"] = e2e["grad_steps_per_sec_e2e"]
+    record.update({k: v for k, v in e2e.items() if k != "grad_steps_per_sec_e2e"})
+
+
+def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
+    """Full flagship menu, stage by stage, newest-information-first under a
+    wall-clock budget: the headline e2e lands first, optional stages are
+    skipped (and named in ``skipped``) once the budget runs low, and a stage
+    failure is recorded without killing the stages after it."""
+    record["fetch_rtt_ms"] = measure_fetch_rtt()
+
+    def remaining() -> float:
+        return deadline - time.monotonic()
+
+    def stage(name: str, est_s: float, fn):
+        if remaining() < est_s:
+            record.setdefault("skipped", []).append(f"{name} (budget: {int(remaining())}s left < est {int(est_s)}s)")
+            return None
+        try:
+            return fn()
+        except Exception as err:  # noqa: BLE001 — a failed stage must not kill the menu
+            record.setdefault("stage_errors", {})[name] = repr(err)
+            return None
+
+    e2e = stage("e2e_S", 240, lambda: measure_e2e(precision))
+    if e2e:
+        record["value"] = e2e["grad_steps_per_sec_e2e"]
+        record["vs_baseline"] = round(record["value"] / BASELINE_E2E_GRAD_STEPS_PER_SEC, 3)
+        record.update({k: v for k, v in e2e.items() if k != "grad_steps_per_sec_e2e"})
+
+    compute = stage("compute_S", 180, lambda: measure_compute(precision))
+    if compute:
+        record.update(compute)
+
     # 4-env variant: one action fetch serves 4 policy steps, amortizing the
     # device-link round trip that bounds the 1-env loop (PERF.md §2); still
     # ratio 1 — four gradient steps per iteration
-    e2e_4env = measure_e2e(precision, num_envs=4)
+    e2e_4env = stage("e2e_S_4env", 240, lambda: measure_e2e(precision, num_envs=4))
+    if e2e_4env:
+        record["grad_steps_per_sec_e2e_4env"] = e2e_4env["grad_steps_per_sec_e2e_pipelined"]
+        record["grad_steps_per_sec_e2e_4env_serialized"] = e2e_4env["grad_steps_per_sec_e2e_serialized"]
+
     # north-star config (BASELINE.md §C): XL single-chip compute + MFU, at the
     # reference batch (16) and at the MXU-saturating batch (64)
-    xl = measure_compute(precision, size="XL", batch_size=16, measure_steps=40)
-    xl_b64 = measure_compute(precision, size="XL", batch_size=64, measure_steps=25)
-    value = e2e["grad_steps_per_sec_e2e"]
-    print(
-        json.dumps(
-            {
-                "metric": "dreamer_v3_S_grad_steps_per_sec_e2e",
-                "value": value,
-                "unit": "grad-steps/s end-to-end (player+env+replay+train, batch 16 x seq 64, ratio 1)",
-                "vs_baseline": round(value / BASELINE_E2E_GRAD_STEPS_PER_SEC, 3),
-                "baseline": "reference DV3-S Atari-100K: 25k grad steps / 14 h on RTX-3080 = 0.496/s e2e",
-                "precision": precision,
-                **({"device_fallback": device_fallback} if device_fallback else {}),
-                "fetch_rtt_ms": fetch_rtt_ms,
-                **{k: v for k, v in e2e.items() if k != "grad_steps_per_sec_e2e"},
-                "grad_steps_per_sec_e2e_4env": e2e_4env["grad_steps_per_sec_e2e_pipelined"],
-                "grad_steps_per_sec_e2e_4env_serialized": e2e_4env["grad_steps_per_sec_e2e_serialized"],
-                **compute,
-                "dreamer_v3_XL": {
-                    k: v for k, v in xl.items() if k not in ("flops_per_step", "device_kind")
-                },
-                "dreamer_v3_XL_b64": {
-                    k: v for k, v in xl_b64.items() if k not in ("flops_per_step", "device_kind")
-                },
-            }
-        )
+    xl = stage("XL_b16", 240, lambda: measure_compute(precision, size="XL", batch_size=16, measure_steps=40))
+    if xl:
+        record["dreamer_v3_XL"] = {k: v for k, v in xl.items() if k not in ("flops_per_step", "device_kind")}
+    xl_b64 = stage("XL_b64", 240, lambda: measure_compute(precision, size="XL", batch_size=64, measure_steps=25))
+    if xl_b64:
+        record["dreamer_v3_XL_b64"] = {
+            k: v for k, v in xl_b64.items() if k not in ("flops_per_step", "device_kind")
+        }
+    # XL end-to-end (player+replay+train) at the reference batch — the
+    # north-star e2e the round-4 PERF.md projection extrapolated to
+    # (VERDICT r4 item 9); fewer iters: each is ~8x an S-size step
+    xl_e2e = stage(
+        "XL_e2e_b16",
+        300,
+        lambda: measure_e2e(precision, size="XL", warmup_iters=3, measure_iters=30),
     )
+    if xl_e2e:
+        record["dreamer_v3_XL_e2e"] = {
+            "grad_steps_per_sec_e2e": xl_e2e["grad_steps_per_sec_e2e"],
+            "grad_steps_per_sec_e2e_serialized": xl_e2e["grad_steps_per_sec_e2e_serialized"],
+        }
+
+
+def main() -> None:
+    precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
+    # hard wall-clock budget: the driver must ALWAYS get the JSON line
+    # (round 4's rc=124 meant zero recorded numbers — VERDICT r4 weak #1)
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    deadline = time.monotonic() + budget_s
+    record = {
+        "metric": "dreamer_v3_S_grad_steps_per_sec_e2e",
+        "value": None,
+        "unit": "grad-steps/s end-to-end (player+env+replay+train, batch 16 x seq 64, ratio 1)",
+        "vs_baseline": None,
+        "baseline": "reference DV3-S Atari-100K: 25k grad steps / 14 h on RTX-3080 = 0.496/s e2e",
+        "precision": precision,
+    }
+    emitted = False
+
+    def _emit() -> None:
+        nonlocal emitted
+        if not emitted:
+            emitted = True
+            print(json.dumps(record), flush=True)
+
+    def _on_term(signum, frame):  # noqa: ANN001
+        # best-effort: if the driver times the bench out (SIGTERM) while a
+        # stage is still in Python-level code, land the partial record
+        # instead of nothing.  (A hang inside a blocking device call cannot
+        # be preempted — the budget gates above keep stages short enough
+        # that this is the rare case, not the common one.)
+        record["terminated"] = f"signal {signum} mid-run — partial results"
+        _emit()
+        raise SystemExit(124)
+
+    import signal
+
+    signal.signal(signal.SIGTERM, _on_term)
+    try:
+        device_fallback = _ensure_responsive_device()
+        if device_fallback:
+            record["device_fallback"] = device_fallback
+            _run_cpu_fallback(record, precision)
+        else:
+            _run_chip_menu(record, precision, deadline)
+    except Exception as err:  # noqa: BLE001 — the JSON line must land regardless
+        record["error"] = repr(err)
+    finally:
+        _emit()
+    if record.get("value") is None:
+        # the JSON landed, but without the headline measurement (top-level
+        # failure, every stage failing inside the stage() wrapper, or the
+        # budget skipping the headline stage): fail at the process level too
+        # so a return-code-gating driver doesn't record success
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":
