@@ -173,20 +173,26 @@ def run_algorithm(cfg: dotdict):
     return runtime.launch(entrypoint, cfg)
 
 
+def _force_cpu_platform_if_selected(cfg: dotdict) -> None:
+    """Force the CPU platform BEFORE any jax array op when the config selects
+    the cpu accelerator: site configuration may pre-register a remote
+    accelerator plugin (e.g. a tunneled TPU) as the default backend, and
+    merely selecting cpu devices later would still initialize — and block
+    on — that backend for the default-placed arrays (PRNG keys, host
+    scalars).  Shared by run/evaluation/registration; callers must invoke it
+    before anything touches jax."""
+    if cfg.fabric.get("accelerator") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def run(args: Optional[Sequence[str]] = None):
     """Train entrypoint (reference cli.py:358-366).  ``args`` defaults to
     ``sys.argv[1:]`` — Hydra-style ``group=option``/``a.b=v`` overrides."""
     overrides = list(args if args is not None else sys.argv[1:])
     cfg = compose(overrides)
-    if cfg.fabric.get("accelerator") == "cpu":
-        # Force the CPU platform BEFORE any jax array op: site configuration
-        # may pre-register a remote accelerator plugin (e.g. a tunneled TPU)
-        # as the default backend, and merely selecting cpu devices later
-        # would still initialize — and block on — that backend for the
-        # default-placed arrays (PRNG keys, host scalars).
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    _force_cpu_platform_if_selected(cfg)
     n_threads = cfg.get("num_threads")
     if n_threads and int(n_threads) > 0:
         # host-side thread budget.  BLAS pools already initialized in this
@@ -244,12 +250,29 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
         raise FileNotFoundError(f"Archived run config not found at '{cfg_path}'")
     with open(cfg_path) as fp:
         cfg = dotdict(yaml.safe_load(fp))
-    # user overrides on top (fabric + float precision typically)
     from sheeprl_tpu.config import deep_merge
 
     deep_merge(cfg, dotdict(nest_dotted(flat)))
-    cfg.run_name = f"{os.path.basename(str(ckpt_path.parent.parent))}_evaluation"
+    if not any(k == "run_name" for k in flat):
+        cfg.run_name = f"{os.path.basename(str(ckpt_path.parent.parent))}_evaluation"
+    user_logger_override = any(
+        k == "metric.logger" or k.startswith("metric.logger.") for k in flat
+    ) or (isinstance(flat.get("metric"), dict) and "logger" in flat["metric"])
+    logger_cfg = cfg.metric.get("logger")
+    if logger_cfg is not None and not user_logger_override:
+        # the archived logger paths are fully resolved and point INSIDE the
+        # training run; re-root them at the FINAL (post-override) evaluation
+        # run dir so eval metrics don't append to the trained run's event
+        # stream — unless the user pointed the logger somewhere explicitly
+        if "root_dir" in logger_cfg:
+            logger_cfg.root_dir = os.path.join("logs", "runs", str(cfg.root_dir))
+        if "name" in logger_cfg:
+            logger_cfg.name = cfg.run_name
+        if "save_dir" in logger_cfg:
+            logger_cfg.save_dir = os.path.join("logs", "runs", str(cfg.root_dir))
     cfg.checkpoint_path = str(ckpt_path)
+    # honors the ARCHIVED config too; nothing has touched jax before this point
+    _force_cpu_platform_if_selected(cfg)
     # force single-device, strategy-free evaluation (reference cli.py:388-401)
     cfg.fabric = dotdict(
         {
@@ -285,6 +308,8 @@ def registration(args: Optional[Sequence[str]] = None) -> None:
 
     deep_merge(cfg, dotdict(nest_dotted(flat)))
     cfg.checkpoint_path = str(ckpt_path)
+    # honors the archived config too; nothing has touched jax before this point
+    _force_cpu_platform_if_selected(cfg)
     from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint
 
     register_model_from_checkpoint(cfg)
