@@ -1,0 +1,76 @@
+"""Inspect what an environment will feed the agent.
+
+Parity target: /root/reference/examples/observation_space.py.  Composes a
+config exactly like the CLI would, builds the wrapped environment, and
+prints the dict observation space next to the `cnn_keys`/`mlp_keys`
+selection — the fastest way to answer "what do I put in
+`algo.cnn_keys.encoder`?" (see `howto/select_observations.md`).
+
+Usage (any CLI overrides work):
+
+    python examples/observation_space.py env=gym env.id=CartPole-v1
+    python examples/observation_space.py env=dmc \
+        env.wrapper.from_pixels=True "algo.cnn_keys.encoder=[rgb]" \
+        env.sync_env=False   # GL renderers need the async (spawn) env path
+
+The agent selection is taken from the composed `algo.*_keys.encoder`, so
+you can pass `exp=dreamer_v3 ...` to see precisely what that experiment
+would consume.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root (no pip install needed)
+
+import gymnasium as gym
+import numpy as np
+
+
+def describe(space: gym.Space) -> str:
+    if isinstance(space, gym.spaces.Box):
+        kind = "image (CxHxW)" if len(space.shape) == 3 else "vector"
+        return f"Box{space.shape} {space.dtype} — {kind}"
+    return str(space)
+
+
+def main(argv: list[str]) -> None:
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.envs.env import make_env, vectorized_env
+
+    overrides = argv or ["env=gym", "env.id=CartPole-v1"]
+    if not any(o.startswith("exp=") for o in overrides):
+        # observation inspection needs no algorithm; PPO is a neutral host
+        # config that accepts both cnn and mlp keys
+        overrides = ["exp=ppo"] + overrides
+    cfg = compose(overrides)
+
+    envs = vectorized_env([make_env(cfg, cfg.seed, 0, None, "inspect", vector_env_idx=0)], sync=cfg.env.sync_env)
+    try:
+        obs_space = envs.single_observation_space
+        obs = envs.reset(seed=cfg.seed)[0]
+
+        print(f"env: {cfg.env.id}  (action space: {envs.single_action_space})")
+        print("observation space:")
+        for key, space in obs_space.spaces.items():
+            sample = np.asarray(obs[key])
+            print(f"  {key:12s} {describe(space):40s} sample[0] shape {sample.shape[1:]}")
+
+        cnn_sel = list(cfg.algo.cnn_keys.encoder)
+        mlp_sel = list(cfg.algo.mlp_keys.encoder)
+        print(f"\nalgo.cnn_keys.encoder = {cnn_sel}")
+        print(f"algo.mlp_keys.encoder = {mlp_sel}")
+        for key in cnn_sel + mlp_sel:
+            if key not in obs_space.spaces:
+                print(f"  !! selected key '{key}' is NOT produced by this environment")
+        unused = [k for k in obs_space.spaces if k not in cnn_sel + mlp_sel]
+        if unused:
+            print(f"keys produced but not selected (dropped at prepare_obs): {unused}")
+    finally:
+        envs.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

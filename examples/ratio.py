@@ -1,0 +1,56 @@
+"""How `algo.replay_ratio` turns policy steps into gradient steps.
+
+Parity target: /root/reference/examples/ratio.py.  The `Ratio` class
+(`sheeprl_tpu/utils/utils.py`) is a credit accumulator: every call banks
+`(new_policy_steps) * ratio` fractional gradient-step credit and pays out
+the integer part, so the exact ratio holds over a run no matter how many
+envs advance per loop iteration.  Run this to see the accounting:
+
+    python examples/ratio.py
+    python examples/ratio.py --ratio 0.5 --num-envs 4 --pretrain-steps 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root (no pip install needed)
+
+from sheeprl_tpu.utils.utils import Ratio
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--ratio", type=float, default=0.3, help="gradient steps per policy step")
+    parser.add_argument("--num-envs", type=int, default=4, help="policy steps added per loop iteration")
+    parser.add_argument("--iterations", type=int, default=12)
+    parser.add_argument("--pretrain-steps", type=int, default=0, help="one-time first-call burst")
+    args = parser.parse_args()
+
+    ratio = Ratio(args.ratio, pretrain_steps=args.pretrain_steps)
+    policy_steps = 0
+    total_grad_steps = 0
+
+    print(f"replay_ratio={args.ratio}  num_envs={args.num_envs}  pretrain_steps={args.pretrain_steps}\n")
+    print(f"{'iter':>4} {'policy_steps':>12} {'grad_steps_paid':>15} {'cumulative':>10} {'exact_ratio':>11}")
+    for it in range(1, args.iterations + 1):
+        policy_steps += args.num_envs  # one action per env per iteration
+        paid = ratio(policy_steps)  # integer gradient steps owed NOW
+        total_grad_steps += paid
+        print(
+            f"{it:>4} {policy_steps:>12} {paid:>15} {total_grad_steps:>10} "
+            f"{total_grad_steps / policy_steps:>11.4f}"
+        )
+
+    print(
+        f"\nover {policy_steps} policy steps: {total_grad_steps} gradient steps "
+        f"(target ratio {args.ratio} -> exact budget {policy_steps * args.ratio:.1f}; "
+        "the fractional remainder is carried, never lost)"
+    )
+    print("checkpointing carries the credit too: Ratio.state_dict() ->", ratio.state_dict())
+
+
+if __name__ == "__main__":
+    main()
