@@ -106,17 +106,18 @@ def _build(cfg_overrides, actions_dim=(6,)):
     return cfg, world_model_def, actor_def, critic_def, params, opt_states, moments_state, train_step
 
 
-def measure_compute(
+def build_train_step_and_batch(
     precision: str,
     size: str = "S",
     batch_size: int = 16,
-    measure_steps: int = MEASURE_STEPS,
+    sequence_length: int = 64,
     extra_overrides=(),
 ):
-    """Per-step timed gradient steps + MFU on random device-resident data.
-    ``extra_overrides`` lets the perf study isolate phases (horizon=1, short
-    sequences, vector-only observations)."""
-    import jax
+    """One compiled-workload recipe, shared by ``measure_compute`` and
+    ``tools/perf_study.py``'s lever study so the two can never drift: the
+    flagship DV3 pixel config + a synthetic batch derived from the composed
+    config's obs keys.  Returns ``(cfg, train_step, state, batch)`` with
+    ``state = {params, opt_states, moments_state}``."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -127,7 +128,7 @@ def measure_compute(
             "env.id=discrete_dummy",
             f"algo=dreamer_v3_{size}",
             f"algo.per_rank_batch_size={batch_size}",
-            "algo.per_rank_sequence_length=64",
+            f"algo.per_rank_sequence_length={sequence_length}",
             "algo.cnn_keys.encoder=[rgb]",
             "algo.cnn_keys.decoder=[rgb]",
             "algo.mlp_keys.encoder=[]",
@@ -150,6 +151,28 @@ def measure_compute(
         batch[k] = jnp.asarray(rng.integers(0, 255, (T, B, 3, 64, 64)), jnp.float32) / 255.0 - 0.5
     for k in set(cfg.algo.mlp_keys.encoder) | set(cfg.algo.mlp_keys.decoder):
         batch[k] = jnp.asarray(rng.normal(size=(T, B, 10)), jnp.float32)
+    state = {"params": params, "opt_states": opt_states, "moments_state": moments_state}
+    return cfg, train_step, state, batch
+
+
+def measure_compute(
+    precision: str,
+    size: str = "S",
+    batch_size: int = 16,
+    measure_steps: int = MEASURE_STEPS,
+    extra_overrides=(),
+):
+    """Per-step timed gradient steps + MFU on random device-resident data.
+    ``extra_overrides`` lets the perf study isolate phases (horizon=1, short
+    sequences, vector-only observations)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cfg, train_step, state, batch = build_train_step_and_batch(
+        precision, size=size, batch_size=batch_size, extra_overrides=extra_overrides
+    )
+    params, opt_states, moments_state = state["params"], state["opt_states"], state["moments_state"]
     key = jax.random.PRNGKey(0)
     tau = jnp.float32(0.02)
 
@@ -404,16 +427,26 @@ def _ensure_responsive_device():
     import sys
 
     reason = None
+    # Popen + poll instead of subprocess.run: a probe child hung on a dead
+    # tunnel can be in UNKILLABLE D-state (stuck in the device driver), and
+    # run()'s TimeoutExpired cleanup then blocks forever in process.wait() —
+    # the probe itself would hang the bench it exists to protect.
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-            capture_output=True,
-            timeout=180,
-        )
-        if out.returncode == 0:
+        rc = proc.wait(timeout=180)
+        if rc == 0:
             return None
-        reason = f"device enumeration failed (exit {out.returncode})"
+        reason = f"device enumeration failed (exit {rc})"
     except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # D-state child: abandon it rather than wait forever
         reason = "accelerator link unresponsive (enumeration timed out)"
     print(f"WARNING: {reason}; benching on CPU", file=sys.stderr)
     import jax

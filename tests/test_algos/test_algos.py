@@ -423,7 +423,37 @@ def test_evaluation_roundtrip():
     assert ckpts
     from sheeprl_tpu.cli import evaluation
 
-    evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu"])
+    evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu", "metric.log_level=1"])
+    # eval metrics must land under the *_evaluation run dir, not append to the
+    # trained run's event stream (round-5 logger re-root fix)
+    eval_events = [p for p in Path("logs").rglob("events.out.tfevents.*") if "_evaluation" in str(p)]
+    assert eval_events, "evaluation wrote no event file under the *_evaluation run dir"
+    train_dir = ckpts[-1].parent.parent
+    train_events = list(train_dir.parent.rglob("events.out.tfevents.*"))
+    assert all("_evaluation" not in str(p) for p in train_events), (
+        f"evaluation appended events inside the training run dir: {train_events}"
+    )
+
+
+def test_external_algorithm_template_example():
+    """The runnable extension-API example registers an external algorithm and
+    dispatches it through the real CLI (howto/register_new_algorithm.md /
+    register_external_algorithm.md contract)."""
+    import subprocess
+
+    repo_root = Path(__file__).resolve().parents[2]
+    script = repo_root / "examples" / "architecture_template.py"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+        cwd=os.getcwd(),  # tmp dir from the autouse fixture — logs stay out of the repo
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "final mean episodic return" in proc.stdout, proc.stdout[-2000:]
 
 
 P2E_TINY = [
