@@ -49,6 +49,7 @@ class _RenderPixels(gym.Wrapper):
         super().__init__(env)
         self._pixel_key = pixel_key
         self._state_key = state_key
+        env.reset()  # gymnasium's OrderEnforcer forbids render() before the first reset
         frame = env.render()
         if frame is None:
             raise RuntimeError(
