@@ -199,8 +199,10 @@ class TestCountItems:
 @pytest.mark.skipif(not gates._IS_DMC_AVAILABLE, reason="dm_control not installed")
 def test_dmc_wrapper_vectors_roundtrip():
     """Real dm_control episode slice: normalized actions in, Dict obs out,
-    no termination mid-episode (reference dmc.py:217-241).  Pixels need a GL
-    backend the image lacks, so vectors only."""
+    no termination mid-episode (reference dmc.py:217-241).  Vectors only:
+    pixel rendering (mesa EGL) needs a pristine spawn-context subprocess
+    (howto/learn_in_dmc.md), which the CLI path provides but this in-process
+    unit test deliberately avoids."""
     from sheeprl_tpu.envs.dmc import DMCWrapper
 
     env = DMCWrapper("cartpole", "balance", from_pixels=False, from_vectors=True, seed=3)
