@@ -33,11 +33,19 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import save_configs
+from sheeprl_tpu.utils.utils import get_diagnostics, save_configs
 
 
 def make_train_step(agent, optimizer, cfg, mesh):
-    """One whole-batch gradient step, data-parallel over the mesh."""
+    """One whole-batch gradient step, data-parallel over the mesh.
+
+    Returns metrics ``[pg_loss, v_loss, grad_norm, nonfinite_steps]``; under
+    ``diagnostics.sentinel.policy=skip_update`` a non-finite update is
+    discarded in-graph (params/opt state keep their pre-step values).
+    """
+    from sheeprl_tpu.diagnostics.sentinel import finite_flag, select_finite, sentinel_spec
+
+    sentinel = sentinel_spec(cfg)
     world = mesh.devices.size
     distributed = world > 1
     cdt = compute_dtype_of(cfg)
@@ -62,9 +70,17 @@ def make_train_step(agent, optimizer, cfg, mesh):
         if distributed:
             grads = jax.lax.pmean(grads, "data")
             aux = jax.lax.pmean(aux, "data")
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, jnp.stack(aux)
+        # one NaN/Inf leaf poisons the global norm: a single scalar health flag
+        gnorm = optax.global_norm(grads)
+        finite = finite_flag(gnorm, *aux)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        if sentinel.skip_update:
+            params = select_finite(finite, new_params, params)
+            opt_state = select_finite(finite, new_opt_state, opt_state)
+        else:
+            params, opt_state = new_params, new_opt_state
+        return params, opt_state, jnp.stack([*aux, gnorm, 1.0 - finite.astype(jnp.float32)])
 
     if distributed:
         from jax import shard_map
@@ -99,6 +115,7 @@ def main(runtime, cfg):
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
     aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
     if cfg.metric.log_level == 0:
         aggregator.disabled = True
@@ -184,7 +201,7 @@ def main(runtime, cfg):
     obs, _ = envs.reset(seed=cfg.seed)
 
     for iter_num in range(start_iter, total_iters + 1):
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), diag.span("rollout"):
             for _ in range(rollout_steps):
                 policy_step_count += num_envs
                 rng_key, step_key = jax.random.split(rng_key)
@@ -253,13 +270,24 @@ def main(runtime, cfg):
             lambda x: jax.device_put(jnp.asarray(x), data_sharding) if data_sharding else jnp.asarray(x),
             flat,
         )
+        device_data = diag.maybe_inject_nan(iter_num, device_data)
 
-        with timer("Time/train_time"):
+        with timer("Time/train_time"), diag.span("train"):
             params, opt_state, losses = train_step(params, opt_state, device_data)
             losses = np.asarray(losses)
 
         aggregator.update("Loss/policy_loss", float(losses[0]))
         aggregator.update("Loss/value_loss", float(losses[1]))
+        aggregator.update("Grads/global_norm", float(losses[2]))
+        diag.on_update(
+            policy_step_count,
+            {
+                "Loss/policy_loss": float(losses[0]),
+                "Loss/value_loss": float(losses[1]),
+                "Grads/global_norm": float(losses[2]),
+            },
+            nonfinite=float(losses[3]),
+        )
 
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
             metrics = aggregator.compute()
@@ -292,7 +320,9 @@ def main(runtime, cfg):
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
-            runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state, replay_buffer=None)
+            with diag.span("checkpoint"):
+                runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state, replay_buffer=None)
+            diag.on_checkpoint(policy_step_count, ckpt_path)
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
@@ -304,3 +334,4 @@ def main(runtime, cfg):
 
         log_models(cfg, {"agent": params}, log_dir)
     logger.finalize()
+    diag.close("completed")

@@ -9,5 +9,6 @@ AGGREGATOR_KEYS = {
     "Game/ep_len_avg",
     "Loss/value_loss",
     "Loss/policy_loss",
+    "Grads/global_norm",
 }
 MODELS_TO_REGISTER = {"agent"}

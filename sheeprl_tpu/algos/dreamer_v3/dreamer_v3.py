@@ -60,7 +60,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import DeviceMetricsDrain, MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, get_diagnostics, save_configs
 
 
 def make_train_step(
@@ -97,10 +97,23 @@ def make_train_step(
     cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
     mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
 
+    from sheeprl_tpu.diagnostics.sentinel import select_finite, sentinel_spec
+
+    sentinel = sentinel_spec(cfg)
+
     def train_step(params, opt_states, moments_state, batch, key, tau):
         T, B = batch["actions"].shape[:2]
         key = fold_key(key, axis)
         k_wm, k_img, k_img_actions = jax.random.split(key, 3)
+
+        # sentinel snapshots: the skip_update guard at the end reverts to
+        # these when the step's metric vector — which includes every loss and
+        # grad norm — goes non-finite.  tree_map rebuilds every container
+        # (leaves shared) so nested in-place mutation can never alias the
+        # snapshot
+        if sentinel.skip_update:
+            copy = lambda tree: jax.tree_util.tree_map(lambda leaf: leaf, tree)  # noqa: E731
+            prev_state = (copy(params), copy(opt_states), moments_state)
 
         # --- target critic Polyak update (reference dreamer_v3.py:713-720) --
         params["target_critic"] = jax.tree_util.tree_map(
@@ -316,6 +329,11 @@ def make_train_step(
             ]
         )
         metrics = pmean_tree(metrics, axis)
+        if sentinel.skip_update:
+            finite = jnp.all(jnp.isfinite(metrics))
+            params, opt_states, moments_state = select_finite(
+                finite, (params, opt_states, moments_state), prev_state
+            )
         return params, opt_states, moments_state, metrics
 
     return dp_jit(
@@ -450,6 +468,7 @@ def _dreamer_main(
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
     aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
     if cfg.metric.log_level == 0:
         aggregator.disabled = True
@@ -602,7 +621,7 @@ def _dreamer_main(
         # tunnel round trip and the host-side env stepping both overlap the
         # device executing the gradient steps (reference hot loop
         # dreamer_v3.py:637-672 serializes these).
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), diag.span("rollout"):
             actions_jnp = None
             if iter_num <= learning_starts and not cfg.checkpoint.resume_from:
                 real_actions = actions = np.asarray(envs.action_space.sample())
@@ -667,21 +686,23 @@ def _dreamer_main(
                 per_rank_gradient_steps = 1
             if per_rank_gradient_steps > 0:
                 has_trained = True
-                local_data = rb.sample(
-                    local_sample_size(cfg.algo.per_rank_batch_size * world_size, use_device_buffer),
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
-                )
-                batches = train_batches(
-                    local_data,
-                    per_rank_gradient_steps,
-                    runtime.mesh if world_size > 1 else None,
-                    cnn_keys,
-                    use_device_buffer,
-                )
+                with diag.span("buffer-sample"):
+                    local_data = rb.sample(
+                        local_sample_size(cfg.algo.per_rank_batch_size * world_size, use_device_buffer),
+                        sequence_length=cfg.algo.per_rank_sequence_length,
+                        n_samples=per_rank_gradient_steps,
+                    )
+                    batches = train_batches(
+                        local_data,
+                        per_rank_gradient_steps,
+                        runtime.mesh if world_size > 1 else None,
+                        cnn_keys,
+                        use_device_buffer,
+                    )
 
-                with timer("Time/train_time"):
+                with timer("Time/train_time"), diag.span("train"):
                     for batch in batches:
+                        batch = diag.maybe_inject_nan(iter_num, batch)
                         target_freq = cfg.algo.critic.get("per_rank_target_network_update_freq", 0)
                         if target_freq and cumulative_grad_steps % target_freq == 0:
                             tau = 1.0 if cumulative_grad_steps == 0 else cfg.algo.critic.get("tau", 1.0)
@@ -696,7 +717,7 @@ def _dreamer_main(
                 metrics_drain.append(metrics)
 
         # ---- fetch the actions, step the envs (device keeps training) -----
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), diag.span("rollout"):
             if actions_jnp is not None:
                 actions = np.asarray(actions_jnp)
                 real_actions = split_real_actions(actions)
@@ -772,7 +793,14 @@ def _dreamer_main(
 
         # ---- log (reference dreamer_v3.py:747-793) ------------------------
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
-            metrics_drain.flush_into(aggregator, metric_order)
+            # the sentinel sees the raw per-gradient-step rows before the
+            # aggregator's NaN filtering drops them (warn/halt policies; the
+            # skip_update selection already happened in-graph)
+            metrics_drain.flush_into(
+                aggregator,
+                metric_order,
+                observer=lambda rows: diag.observe_rows(policy_step_count, metric_order, rows),
+            )
             metrics_dict = aggregator.compute()
             timers = timer.compute()
             if timers.get("Time/train_time", 0) > 0:
@@ -808,12 +836,14 @@ def _dreamer_main(
                 "last_checkpoint": last_checkpoint,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
-            runtime.call(
-                "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-            )
+            with diag.span("checkpoint"):
+                runtime.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
+            diag.on_checkpoint(policy_step_count, ckpt_path)
 
     envs.close()
     cumulative_rew = None
@@ -830,4 +860,5 @@ def _dreamer_main(
 
         log_models(cfg, params, log_dir)
     logger.finalize()
+    diag.close("completed")
     return cumulative_rew

@@ -84,10 +84,22 @@ def make_train_step(
     projector_def = _HEADS["projector_def"]
     predictor_def = _HEADS["predictor_def"]
 
+    from sheeprl_tpu.diagnostics.sentinel import select_finite, sentinel_spec
+
+    sentinel = sentinel_spec(cfg)
+
     def train_step(params, opt_states, moments_state, batch, key, tau):
         T, B = batch["actions"].shape[:2]
         key = fold_key(key, axis)
         k_wm, k_img, k_img_actions, k_views = jax.random.split(key, 4)
+
+        # sentinel snapshots for the skip_update guard at the end.  tree_map
+        # rebuilds every container (leaves shared): a plain dict(params) would
+        # alias the nested params["jepa"] dict, which IS mutated in place
+        # below, and the guard could never revert the JEPA heads
+        if sentinel.skip_update:
+            copy = lambda tree: jax.tree_util.tree_map(lambda leaf: leaf, tree)  # noqa: E731
+            prev_state = (copy(params), copy(opt_states), moments_state)
 
         params["target_critic"] = jax.tree_util.tree_map(
             lambda c, t: tau * c + (1 - tau) * t, params["critic"], params["target_critic"]
@@ -327,6 +339,11 @@ def make_train_step(
             ]
         )
         metrics = pmean_tree(metrics, axis)
+        if sentinel.skip_update:
+            finite = jnp.all(jnp.isfinite(metrics))
+            params, opt_states, moments_state = select_finite(
+                finite, (params, opt_states, moments_state), prev_state
+            )
         return params, opt_states, moments_state, metrics
 
     return dp_jit(

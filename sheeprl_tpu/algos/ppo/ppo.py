@@ -39,7 +39,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import get_diagnostics, polynomial_decay, save_configs
 
 
 def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, batch_size: int):
@@ -50,7 +50,16 @@ def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, batch_siz
     mesh's ``data`` axis.  Each device permutes its local shard per epoch (the
     reference's per-rank RandomSampler, ppo.py:57-65) and gradients are
     ``pmean``-ed per minibatch (DDP all-reduce equivalent).
+
+    ``metrics`` is ``[pg_loss, v_loss, e_loss, grad_norm, nonfinite_steps]``:
+    the diagnostics sentinel's finiteness flag and the global grad norm ride
+    the existing metric fetch, and under
+    ``diagnostics.sentinel.policy=skip_update`` a non-finite minibatch update
+    is discarded in-graph (params/opt state keep their pre-step values).
     """
+    from sheeprl_tpu.diagnostics.sentinel import finite_flag, select_finite, sentinel_spec
+
+    sentinel = sentinel_spec(cfg)
     world = mesh.devices.size
     distributed = world > 1
     cdt = compute_dtype_of(cfg)  # bf16 under fabric.precision=bf16-*
@@ -101,15 +110,28 @@ def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, batch_siz
                 if distributed:
                     grads = jax.lax.pmean(grads, "data")
                     aux = jax.lax.pmean(aux, "data")
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), jnp.stack(aux)
+                # any NaN/Inf gradient leaf poisons the global norm, so one
+                # scalar check covers the whole tree; pmean'd inputs mean
+                # every device takes the same branch of the select below
+                gnorm = optax.global_norm(grads)
+                finite = finite_flag(gnorm, *aux)
+                updates, new_opt_state = optimizer.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                if sentinel.skip_update:
+                    params = select_finite(finite, new_params, params)
+                    opt_state = select_finite(finite, new_opt_state, opt_state)
+                else:
+                    params, opt_state = new_params, new_opt_state
+                stats = jnp.stack([*aux, gnorm, 1.0 - finite.astype(jnp.float32)])
+                return (params, opt_state), stats
 
             return jax.lax.scan(mb_body, (params, opt_state), idxs)
 
         keys = jax.random.split(key, cfg.algo.update_epochs)
         (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), keys)
-        metrics = jnp.mean(losses.reshape(-1, 3), axis=0)
+        flat = losses.reshape(-1, 5)
+        # mean losses/grad-norm over minibatches; nonfinite steps are a count
+        metrics = jnp.concatenate([jnp.mean(flat[:, :4], axis=0), jnp.sum(flat[:, 4:], axis=0)])
         return params, opt_state, metrics
 
     if distributed:
@@ -162,6 +184,7 @@ def main(runtime, cfg):
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
     aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
     if cfg.metric.log_level == 0:
         aggregator.disabled = True
@@ -288,7 +311,7 @@ def main(runtime, cfg):
     obs, _ = envs.reset(seed=cfg.seed)
 
     for iter_num in range(start_iter, total_iters + 1):
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), diag.span("rollout"):
             for _ in range(rollout_steps):
                 policy_step_count += num_envs  # global env steps (num_envs spans the whole mesh)
                 # sample actions (device) ------------------------------------
@@ -343,31 +366,33 @@ def main(runtime, cfg):
                 obs = next_obs
 
         # ---- GAE over the collected rollout (reference ppo.py:344-360) ----
-        local = {k: np.asarray(rb[k][:rollout_steps]) for k in rb.buffer.keys()}
-        torch_last_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
-        returns, advantages = gae_step(
-            params,
-            torch_last_obs,
-            jnp.asarray(local["rewards"]),
-            jnp.asarray(local["values"]),
-            jnp.asarray(local["dones"]),
-        )
-        local["returns"] = np.asarray(returns)
-        local["advantages"] = np.asarray(advantages)
+        with diag.span("buffer-sample"):
+            local = {k: np.asarray(rb[k][:rollout_steps]) for k in rb.buffer.keys()}
+            torch_last_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+            returns, advantages = gae_step(
+                params,
+                torch_last_obs,
+                jnp.asarray(local["rewards"]),
+                jnp.asarray(local["values"]),
+                jnp.asarray(local["dones"]),
+            )
+            local["returns"] = np.asarray(returns)
+            local["advantages"] = np.asarray(advantages)
 
-        # flatten [T, N, ...] -> [T*N, ...]; device-shard along the data axis
-        flat = {
-            "obs": {k: local[k].reshape(total_local, *local[k].shape[2:]) for k in obs_keys},
-            "actions": local["actions"].reshape(total_local, -1),
-            "logprobs": local["logprobs"].reshape(total_local, -1),
-            "values": local["values"].reshape(total_local, -1),
-            "returns": local["returns"].reshape(total_local, -1),
-            "advantages": local["advantages"].reshape(total_local, -1),
-        }
-        device_data = jax.tree_util.tree_map(
-            lambda x: jax.device_put(jnp.asarray(x), data_sharding) if data_sharding else jnp.asarray(x),
-            flat,
-        )
+            # flatten [T, N, ...] -> [T*N, ...]; device-shard along the data axis
+            flat = {
+                "obs": {k: local[k].reshape(total_local, *local[k].shape[2:]) for k in obs_keys},
+                "actions": local["actions"].reshape(total_local, -1),
+                "logprobs": local["logprobs"].reshape(total_local, -1),
+                "values": local["values"].reshape(total_local, -1),
+                "returns": local["returns"].reshape(total_local, -1),
+                "advantages": local["advantages"].reshape(total_local, -1),
+            }
+            device_data = jax.tree_util.tree_map(
+                lambda x: jax.device_put(jnp.asarray(x), data_sharding) if data_sharding else jnp.asarray(x),
+                flat,
+            )
+        device_data = diag.maybe_inject_nan(iter_num, device_data)
 
         # ---- annealing (reference ppo.py:415-424) -------------------------
         if cfg.algo.anneal_clip_coef:
@@ -380,7 +405,7 @@ def main(runtime, cfg):
             )
 
         # ---- update phase: one jitted graph (reference ppo.py:30-102) -----
-        with timer("Time/train_time"):
+        with timer("Time/train_time"), diag.span("train"):
             rng_key, train_key = jax.random.split(rng_key)
             coefs = (
                 jnp.asarray(clip_coef, jnp.float32),
@@ -393,6 +418,17 @@ def main(runtime, cfg):
         aggregator.update("Loss/policy_loss", float(losses[0]))
         aggregator.update("Loss/value_loss", float(losses[1]))
         aggregator.update("Loss/entropy_loss", float(losses[2]))
+        aggregator.update("Grads/global_norm", float(losses[3]))
+        diag.on_update(
+            policy_step_count,
+            {
+                "Loss/policy_loss": float(losses[0]),
+                "Loss/value_loss": float(losses[1]),
+                "Loss/entropy_loss": float(losses[2]),
+                "Grads/global_norm": float(losses[3]),
+            },
+            nonfinite=float(losses[4]),
+        )
 
         # ---- logging (reference ppo.py:386-413) ---------------------------
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
@@ -429,12 +465,14 @@ def main(runtime, cfg):
                 "batch_size": batch_size * world_size,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
-            runtime.call(
-                "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-                replay_buffer=None,
-            )
+            with diag.span("checkpoint"):
+                runtime.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=None,
+                )
+            diag.on_checkpoint(policy_step_count, ckpt_path)
 
     envs.close()
     # ---- final test episode (reference ppo.py:445-453) --------------------
@@ -449,4 +487,5 @@ def main(runtime, cfg):
 
         log_models(cfg, {"agent": params}, log_dir)
     logger.finalize()
+    diag.close("completed")
     return cumulative_rew

@@ -40,7 +40,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import get_diagnostics, polynomial_decay, save_configs
 
 
 @register_algorithm(decoupled=True)
@@ -77,6 +77,7 @@ def main(runtime, cfg):
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
     aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
     if cfg.metric.log_level == 0:
         aggregator.disabled = True
@@ -180,7 +181,7 @@ def main(runtime, cfg):
 
     for iter_num in range(start_iter, total_iters + 1):
         # ---- PLAYER: rollout on device 0 (reference ppo_decoupled.py:169-299)
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), diag.span("rollout"):
             for _ in range(rollout_steps):
                 policy_step_count += num_envs
                 rng_key, step_key = jax.random.split(rng_key)
@@ -250,6 +251,7 @@ def main(runtime, cfg):
         device_data = jax.tree_util.tree_map(
             lambda x: jax.device_put(jnp.asarray(x), trainer_data_sharding), flat
         )
+        device_data = diag.maybe_inject_nan(iter_num, device_data)
 
         if cfg.algo.anneal_clip_coef:
             clip_coef = polynomial_decay(
@@ -261,7 +263,7 @@ def main(runtime, cfg):
             )
 
         # ---- TRAINERS: update epochs on the sub-mesh ----------------------
-        with timer("Time/train_time"):
+        with timer("Time/train_time"), diag.span("train"):
             rng_key, train_key = jax.random.split(rng_key)
             coefs = (
                 jnp.asarray(clip_coef, jnp.float32),
@@ -279,6 +281,17 @@ def main(runtime, cfg):
         aggregator.update("Loss/policy_loss", float(losses[0]))
         aggregator.update("Loss/value_loss", float(losses[1]))
         aggregator.update("Loss/entropy_loss", float(losses[2]))
+        aggregator.update("Grads/global_norm", float(losses[3]))
+        diag.on_update(
+            policy_step_count,
+            {
+                "Loss/policy_loss": float(losses[0]),
+                "Loss/value_loss": float(losses[1]),
+                "Loss/entropy_loss": float(losses[2]),
+                "Grads/global_norm": float(losses[3]),
+            },
+            nonfinite=float(losses[4]),
+        )
 
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
             metrics = aggregator.compute()
@@ -313,7 +326,9 @@ def main(runtime, cfg):
                 "batch_size": batch_size * n_trainers,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
-            runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state, replay_buffer=None)
+            with diag.span("checkpoint"):
+                runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state, replay_buffer=None)
+            diag.on_checkpoint(policy_step_count, ckpt_path)
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
@@ -321,3 +336,4 @@ def main(runtime, cfg):
         cumulative_rew = test(agent.apply, player_params, test_env, runtime, cfg, log_dir)
         logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, policy_step_count)
     logger.finalize()
+    diag.close("completed")

@@ -14,6 +14,7 @@ AGGREGATOR_KEYS = {
     "Loss/value_loss",
     "Loss/policy_loss",
     "Loss/entropy_loss",
+    "Grads/global_norm",
 }
 MODELS_TO_REGISTER = {"agent"}
 

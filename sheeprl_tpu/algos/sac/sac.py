@@ -34,11 +34,21 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, get_diagnostics, save_configs
 
 
 def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy: float):
-    """Jitted multi-gradient-step update over ``[G, B, ...]`` batches."""
+    """Jitted multi-gradient-step update over ``[G, B, ...]`` batches.
+
+    The returned metric vector is ``[qf_loss, actor_loss, alpha_loss,
+    grad_norm, nonfinite_steps]``; under
+    ``diagnostics.sentinel.policy=skip_update`` a scan step whose losses or
+    combined grad norm go non-finite has its whole critic/target/actor/alpha
+    update discarded in-graph (the carry keeps its pre-step values).
+    """
+    from sheeprl_tpu.diagnostics.sentinel import finite_flag, select_finite, sentinel_spec
+
+    sentinel = sentinel_spec(cfg)
     world = mesh.devices.size
     distributed = world > 1
     tau = cfg.algo.tau
@@ -47,6 +57,12 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
     def one_step(carry, inp):
         params, opt_states = carry
         batch, key = inp
+        # snapshots for the sentinel's skip selection: tree_map rebuilds every
+        # container (leaves shared), so the snapshot can never alias a dict
+        # the update below mutates in place
+        if sentinel.skip_update:
+            prev_params = jax.tree_util.tree_map(lambda leaf: leaf, params)
+            prev_opt_states = jax.tree_util.tree_map(lambda leaf: leaf, opt_states)
         # network inputs in the compute dtype; TD targets stay fp32
         obs_c = cast_floating(batch["observations"], cdt)
         next_obs_c = cast_floating(batch["next_observations"], cdt)
@@ -118,11 +134,26 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
         )
         params["log_alpha"] = optax.apply_updates(params["log_alpha"], updates)
 
-        return (params, opt_states), jnp.stack([qf_l, actor_l, alpha_l])
+        # combined grad norm over the three sequential updates; a NaN/Inf in
+        # any grad tree (or loss) poisons it, giving one scalar health flag
+        gnorm = jnp.sqrt(
+            optax.global_norm(qf_grads) ** 2
+            + optax.global_norm(actor_grads) ** 2
+            + optax.global_norm(alpha_grads) ** 2
+        )
+        finite = finite_flag(gnorm, qf_l, actor_l, alpha_l)
+        if sentinel.skip_update:
+            params = select_finite(finite, params, prev_params)
+            opt_states = select_finite(finite, opt_states, prev_opt_states)
+
+        stats = jnp.stack([qf_l, actor_l, alpha_l, gnorm, 1.0 - finite.astype(jnp.float32)])
+        return (params, opt_states), stats
 
     def update(params, opt_states, data, keys):
         (params, opt_states), losses = jax.lax.scan(one_step, (params, opt_states), (data, keys))
-        return params, opt_states, jnp.mean(losses, axis=0)
+        # mean losses/grad-norm over gradient steps; nonfinite steps are a count
+        metrics = jnp.concatenate([jnp.mean(losses[:, :4], axis=0), jnp.sum(losses[:, 4:], axis=0)])
+        return params, opt_states, metrics
 
     if distributed:
         from jax import shard_map
@@ -156,6 +187,7 @@ def main(runtime, cfg):
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
     aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
     if cfg.metric.log_level == 0:
         aggregator.disabled = True
@@ -239,7 +271,7 @@ def main(runtime, cfg):
 
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), diag.span("rollout"):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
@@ -289,23 +321,37 @@ def main(runtime, cfg):
                 per_rank_gradient_steps = 1
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sample = rb.sample(
-                        batch_size=local_sample_size(batch_size * world_size),
-                        n_samples=per_rank_gradient_steps,
-                        sample_next_obs=cfg.buffer.sample_next_obs,
-                    )  # [G, B*world, ...]
-                    data = {
-                        k: jnp.asarray(np.asarray(v), jnp.float32)
-                        for k, v in sample.items()
-                        if k in ("observations", "next_observations", "actions", "rewards", "terminated")
-                    }
-                    rng_key, scan_key = jax.random.split(rng_key)
-                    keys = jax.random.split(scan_key, per_rank_gradient_steps)
-                    params, opt_states, losses = train_step(params, opt_states, data, keys)
-                    losses = np.asarray(losses)
+                    with diag.span("buffer-sample"):
+                        sample = rb.sample(
+                            batch_size=local_sample_size(batch_size * world_size),
+                            n_samples=per_rank_gradient_steps,
+                            sample_next_obs=cfg.buffer.sample_next_obs,
+                        )  # [G, B*world, ...]
+                        data = {
+                            k: jnp.asarray(np.asarray(v), jnp.float32)
+                            for k, v in sample.items()
+                            if k in ("observations", "next_observations", "actions", "rewards", "terminated")
+                        }
+                    data = diag.maybe_inject_nan(iter_num, data)
+                    with diag.span("train"):
+                        rng_key, scan_key = jax.random.split(rng_key)
+                        keys = jax.random.split(scan_key, per_rank_gradient_steps)
+                        params, opt_states, losses = train_step(params, opt_states, data, keys)
+                        losses = np.asarray(losses)
                 aggregator.update("Loss/value_loss", float(losses[0]))
                 aggregator.update("Loss/policy_loss", float(losses[1]))
                 aggregator.update("Loss/alpha_loss", float(losses[2]))
+                aggregator.update("Grads/global_norm", float(losses[3]))
+                diag.on_update(
+                    policy_step_count,
+                    {
+                        "Loss/value_loss": float(losses[0]),
+                        "Loss/policy_loss": float(losses[1]),
+                        "Loss/alpha_loss": float(losses[2]),
+                        "Grads/global_norm": float(losses[3]),
+                    },
+                    nonfinite=float(losses[4]),
+                )
 
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
             metrics = aggregator.compute()
@@ -337,12 +383,14 @@ def main(runtime, cfg):
                 "batch_size": batch_size * world_size,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
-            runtime.call(
-                "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-            )
+            with diag.span("checkpoint"):
+                runtime.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
+            diag.on_checkpoint(policy_step_count, ckpt_path)
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
@@ -354,3 +402,4 @@ def main(runtime, cfg):
 
         log_models(cfg, {"agent": params}, log_dir)
     logger.finalize()
+    diag.close("completed")

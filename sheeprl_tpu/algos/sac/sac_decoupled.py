@@ -32,7 +32,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, get_diagnostics, save_configs
 
 
 @register_algorithm(decoupled=True)
@@ -60,6 +60,7 @@ def main(runtime, cfg):
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
     aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
     if cfg.metric.log_level == 0:
         aggregator.disabled = True
@@ -148,7 +149,7 @@ def main(runtime, cfg):
 
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), diag.span("rollout"):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
@@ -197,25 +198,39 @@ def main(runtime, cfg):
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
                     # player samples; batches "scattered" onto the trainer mesh
-                    sample = rb.sample(
-                        batch_size=batch_size * n_trainers,
-                        n_samples=per_rank_gradient_steps,
-                        sample_next_obs=cfg.buffer.sample_next_obs,
-                    )
-                    data = {
-                        k: jax.device_put(jnp.asarray(np.asarray(v), jnp.float32), trainer_data_sharding)
-                        for k, v in sample.items()
-                        if k in ("observations", "next_observations", "actions", "rewards", "terminated")
-                    }
-                    rng_key, scan_key = jax.random.split(rng_key)
-                    keys = jax.random.split(scan_key, per_rank_gradient_steps)
-                    params, opt_states, losses = train_step(params, opt_states, data, keys)
-                    losses = np.asarray(losses)
+                    with diag.span("buffer-sample"):
+                        sample = rb.sample(
+                            batch_size=batch_size * n_trainers,
+                            n_samples=per_rank_gradient_steps,
+                            sample_next_obs=cfg.buffer.sample_next_obs,
+                        )
+                        data = {
+                            k: jax.device_put(jnp.asarray(np.asarray(v), jnp.float32), trainer_data_sharding)
+                            for k, v in sample.items()
+                            if k in ("observations", "next_observations", "actions", "rewards", "terminated")
+                        }
+                    data = diag.maybe_inject_nan(iter_num, data)
+                    with diag.span("train"):
+                        rng_key, scan_key = jax.random.split(rng_key)
+                        keys = jax.random.split(scan_key, per_rank_gradient_steps)
+                        params, opt_states, losses = train_step(params, opt_states, data, keys)
+                        losses = np.asarray(losses)
                 # actor params broadcast back to the player (reference :550-554)
                 player_actor_params = jax.device_put(params["actor"], player_device)
                 aggregator.update("Loss/value_loss", float(losses[0]))
                 aggregator.update("Loss/policy_loss", float(losses[1]))
                 aggregator.update("Loss/alpha_loss", float(losses[2]))
+                aggregator.update("Grads/global_norm", float(losses[3]))
+                diag.on_update(
+                    policy_step_count,
+                    {
+                        "Loss/value_loss": float(losses[0]),
+                        "Loss/policy_loss": float(losses[1]),
+                        "Loss/alpha_loss": float(losses[2]),
+                        "Grads/global_norm": float(losses[3]),
+                    },
+                    nonfinite=float(losses[4]),
+                )
 
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
             metrics = aggregator.compute()
@@ -247,12 +262,14 @@ def main(runtime, cfg):
                 "batch_size": batch_size * n_trainers,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
-            runtime.call(
-                "on_checkpoint_player",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-            )
+            with diag.span("checkpoint"):
+                runtime.call(
+                    "on_checkpoint_player",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
+            diag.on_checkpoint(policy_step_count, ckpt_path)
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
@@ -260,3 +277,4 @@ def main(runtime, cfg):
         cumulative_rew = test(actor_def.apply, player_actor_params, test_env, runtime, cfg, log_dir)
         logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, policy_step_count)
     logger.finalize()
+    diag.close("completed")

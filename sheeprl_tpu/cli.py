@@ -157,20 +157,38 @@ def run_algorithm(cfg: dotdict):
             cfg.model_manager.models = dotdict({k: v for k, v in mm.items() if k in models})
 
     runtime = instantiate(cfg.fabric)
-    profiler_cfg = cfg.metric.get("profiler", {})
-    if profiler_cfg.get("enabled", False):
-        # one trace around the whole run: compile + steps + host gaps all land
-        # in the same Perfetto timeline (SURVEY §5 profiling upgrade)
-        import jax
+    # Run-health facade (journal / sentinel / tracing): built here, attached
+    # to the runtime, opened by the training loop once the run dir exists
+    # (utils.get_diagnostics / utils.logger plumbing).
+    from sheeprl_tpu.diagnostics import SentinelHalt, build_diagnostics
 
-        trace_dir = profiler_cfg.get("trace_dir") or os.path.join("logs", "profiler_trace")
-        os.makedirs(trace_dir, exist_ok=True)
-        jax.profiler.start_trace(trace_dir)
-        try:
-            return runtime.launch(entrypoint, cfg)
-        finally:
-            jax.profiler.stop_trace()
-    return runtime.launch(entrypoint, cfg)
+    diagnostics = runtime.diagnostics = build_diagnostics(cfg)
+    status = "completed"
+    try:
+        profiler_cfg = cfg.metric.get("profiler", {})
+        if profiler_cfg.get("enabled", False):
+            # one trace around the whole run: compile + steps + host gaps all
+            # land in the same Perfetto timeline (SURVEY §5 profiling upgrade)
+            import jax
+
+            trace_dir = profiler_cfg.get("trace_dir") or os.path.join("logs", "profiler_trace")
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+            try:
+                return runtime.launch(entrypoint, cfg)
+            finally:
+                jax.profiler.stop_trace()
+        return runtime.launch(entrypoint, cfg)
+    except SentinelHalt:
+        status = "halted"
+        raise
+    except BaseException:
+        status = "aborted"
+        raise
+    finally:
+        # idempotent: a loop that finished cleanly already closed with
+        # status="completed"; this covers exceptions (journal gets run_end)
+        diagnostics.close(status)
 
 
 def _force_cpu_platform_if_selected(cfg: dotdict) -> None:
@@ -270,6 +288,15 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
             logger_cfg.name = cfg.run_name
         if "save_dir" in logger_cfg:
             logger_cfg.save_dir = os.path.join("logs", "runs", str(cfg.root_dir))
+        # wandb/mlflow don't carry a `name` key in their archived configs, so
+        # the branch above leaves their eval runs indistinguishable from the
+        # training run; inject the backend's run-name kwarg so they show up
+        # as `*_evaluation` like the tensorboard layout does
+        target = str(logger_cfg.get("_target_", ""))
+        if target.endswith("WandbLogger"):
+            logger_cfg.name = cfg.run_name  # wandb.init(name=...)
+        elif target.endswith("MLFlowLogger"):
+            logger_cfg.run_name = cfg.run_name  # mlflow.start_run(run_name=...)
     cfg.checkpoint_path = str(ckpt_path)
     # honors the ARCHIVED config too; nothing has touched jax before this point
     _force_cpu_platform_if_selected(cfg)

@@ -1,0 +1,251 @@
+"""Run-health & observability subsystem.
+
+Three pillars behind one facade (ISSUE 1 tentpole):
+
+* :mod:`~sheeprl_tpu.diagnostics.journal` — crash-safe JSONL run journal
+  (write-ahead metric/event log; makes TensorBoard archaeology and the
+  reward-recovery toolchain unnecessary for new runs);
+* :mod:`~sheeprl_tpu.diagnostics.sentinel` — jit-compatible NaN/divergence
+  sentinel (``warn`` / ``skip_update`` / ``halt``) + host-side rolling
+  divergence detector;
+* :mod:`~sheeprl_tpu.diagnostics.tracing` — step-phase Chrome-trace spans
+  (rollout / buffer-sample / train / checkpoint) viewable in Perfetto,
+  complementing the device-side ``jax.profiler`` gate.
+
+The facade is constructed once in ``cli.run_algorithm`` from the
+``configs/diagnostics/`` group and attached to the :class:`Runtime`; training
+loops pick it up through ``sheeprl_tpu.utils.utils.get_diagnostics`` and the
+rank-0 logger proxy journals every aggregated metric automatically, so
+non-flagship algorithms inherit journaling without loop changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import warnings
+from contextlib import nullcontext
+from typing import Any, Dict, Mapping, Optional
+
+from sheeprl_tpu.diagnostics.journal import JOURNAL_NAME, RunJournal, find_journal, iter_journal, read_journal
+from sheeprl_tpu.diagnostics.sentinel import (
+    DivergenceDetector,
+    SentinelHalt,
+    SentinelSpec,
+    poison_tree,
+    sentinel_spec,
+)
+from sheeprl_tpu.diagnostics.tracing import TRACE_NAME, NullTracer, PhaseTracer
+
+__all__ = [
+    "Diagnostics",
+    "DivergenceDetector",
+    "JOURNAL_NAME",
+    "NullTracer",
+    "PhaseTracer",
+    "RunJournal",
+    "SentinelHalt",
+    "SentinelSpec",
+    "TRACE_NAME",
+    "build_diagnostics",
+    "config_hash",
+    "find_journal",
+    "iter_journal",
+    "read_journal",
+    "sentinel_spec",
+]
+
+
+def config_hash(cfg: Mapping[str, Any]) -> str:
+    """Stable short hash of the composed run config (journaled at run_start,
+    so any journal can be matched to the exact configuration that made it)."""
+    import yaml
+
+    plain = cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg)
+    return hashlib.sha256(yaml.safe_dump(plain, sort_keys=True).encode()).hexdigest()[:16]
+
+
+class Diagnostics:
+    """Facade over journal + sentinel + tracer with rank-0 gating.
+
+    Construct via :func:`build_diagnostics`; call :meth:`open` once the run's
+    log dir exists (``get_diagnostics`` does both).  Every method is a no-op
+    until opened — and stays one on non-rank-0 hosts or when
+    ``diagnostics.enabled=False`` — so hook calls in the training loops are
+    unconditional.
+    """
+
+    def __init__(self, cfg: Optional[Mapping[str, Any]] = None):
+        self._cfg = cfg
+        diag_cfg = (cfg or {}).get("diagnostics") or {}
+        self.enabled = bool(diag_cfg.get("enabled", False))
+        self._journal_cfg = diag_cfg.get("journal") or {}
+        self._trace_cfg = diag_cfg.get("trace") or {}
+        self.sentinel: SentinelSpec = sentinel_spec(cfg or {})
+        div_cfg = (diag_cfg.get("sentinel") or {}).get("divergence") or {}
+        self._detector: Optional[DivergenceDetector] = None
+        if self.enabled and div_cfg.get("enabled", True):
+            self._detector = DivergenceDetector(
+                window=int(div_cfg.get("window", 20)),
+                min_points=int(div_cfg.get("min_points", 5)),
+                loss_explosion_ratio=float(div_cfg.get("loss_explosion_ratio", 10.0) or 0.0),
+                entropy_key=div_cfg.get("entropy_key"),
+                entropy_floor=div_cfg.get("entropy_floor"),
+            )
+        self.journal: Optional[RunJournal] = None
+        self.tracer = NullTracer()
+        self.log_dir: Optional[str] = None
+        self._rank_zero = True
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def open(self, log_dir: str, rank_zero: bool = True) -> "Diagnostics":
+        """Open journal/tracer inside ``log_dir`` (idempotent, rank-0 only)."""
+        if not self.enabled or self.log_dir is not None:
+            return self
+        self.log_dir = str(log_dir)
+        self._rank_zero = bool(rank_zero)
+        if not self._rank_zero:
+            return self
+        if self._journal_cfg.get("enabled", True):
+            self.journal = RunJournal(
+                os.path.join(self.log_dir, JOURNAL_NAME),
+                fsync_every=int(self._journal_cfg.get("fsync_every", 1)),
+            )
+        if self._trace_cfg.get("enabled", False):
+            trace_path = self._trace_cfg.get("path") or os.path.join(self.log_dir, TRACE_NAME)
+            import jax
+
+            self.tracer = PhaseTracer(trace_path, pid=jax.process_index())
+        if self.journal is not None:
+            cfg = self._cfg or {}
+            self.journal.write(
+                "run_start",
+                config_hash=config_hash(cfg),
+                algo=(cfg.get("algo") or {}).get("name"),
+                env=(cfg.get("env") or {}).get("id"),
+                seed=cfg.get("seed"),
+                exp_name=cfg.get("exp_name"),
+                run_name=cfg.get("run_name"),
+                log_dir=self.log_dir,
+                sentinel_policy=self.sentinel.policy if self.sentinel.enabled else None,
+            )
+        return self
+
+    def close(self, status: str = "completed") -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.journal is not None:
+            self.journal.write("run_end", status=status)
+            self.journal.close()
+        self.tracer.close()
+
+    # -- tracing -----------------------------------------------------------
+    def span(self, name: str, **args: Any):
+        """Phase span context manager (no-op unless tracing is open)."""
+        if isinstance(self.tracer, NullTracer):
+            return nullcontext()
+        return self.tracer.span(name, **args)
+
+    # -- journal hooks -----------------------------------------------------
+    def log_metrics(self, step: Optional[int], metrics: Mapping[str, Any]) -> None:
+        """Journal one aggregated-metrics interval + run divergence checks.
+
+        Called by the rank-0 logger proxy right after the metrics went to
+        TensorBoard/W&B, so the journal mirrors exactly what was logged.
+        """
+        if not metrics:
+            return
+        if self.journal is not None:
+            self.journal.write("metrics", step=step, metrics=dict(metrics))
+        if self._detector is not None and self._rank_zero:
+            for event in self._detector.observe(step, metrics):
+                self._journal_divergence(event)
+
+    def on_checkpoint(self, step: Optional[int], path: str) -> None:
+        if self.journal is not None:
+            self.journal.write("checkpoint", step=step, path=str(path))
+        self.tracer.instant("checkpoint", step=step)
+
+    def _journal_divergence(self, event: Dict[str, Any]) -> None:
+        if self.journal is not None:
+            kind = event.pop("kind", "unknown")
+            step = event.pop("step", None)
+            self.journal.write("divergence", kind=kind, step=step, **event)
+            self.tracer.instant(f"divergence:{kind}", step=step)
+
+    # -- sentinel host side ------------------------------------------------
+    def on_update(self, step: Optional[int], stats: Mapping[str, Any], nonfinite: float = 0.0) -> None:
+        """Digest one (fetched) train-step metric bundle.
+
+        ``nonfinite`` is the in-graph count of optimizer steps whose
+        loss/grad-norm finiteness flag tripped.  Journals a structured
+        ``divergence`` event and applies the configured policy: ``warn``
+        warns, ``skip_update`` already discarded the bad update in-graph (so
+        this only records it), ``halt`` raises :class:`SentinelHalt`.
+        """
+        if not (self.enabled and self.sentinel.enabled):
+            return
+        nonfinite = float(nonfinite)
+        if nonfinite <= 0:
+            return
+        self._journal_divergence(
+            {
+                "kind": "nonfinite_update",
+                "step": step,
+                "nonfinite_steps": nonfinite,
+                "policy": self.sentinel.policy,
+                **{k: v for k, v in stats.items()},
+            }
+        )
+        if self.sentinel.policy == "halt":
+            self.close("halted")
+            raise SentinelHalt(
+                f"non-finite training update at step {step} "
+                f"(nonfinite optimizer steps this interval: {nonfinite:g}); "
+                "diagnostics.sentinel.policy=halt"
+            )
+        if self.sentinel.policy == "warn" and self._rank_zero:
+            warnings.warn(
+                f"Sentinel: non-finite training update at step {step} "
+                f"({nonfinite:g} optimizer steps); params may be corrupted "
+                "(diagnostics.sentinel.policy=warn)",
+                RuntimeWarning,
+            )
+
+    def observe_rows(self, step: Optional[int], names, rows) -> None:
+        """Sentinel digest for the Dreamer metric drain: ``rows`` is a list of
+        per-gradient-step metric vectors (ordered as ``names``) fetched at the
+        log boundary.  Counts rows with any non-finite entry; under
+        ``skip_update`` those steps were already discarded in-graph."""
+        if not (self.enabled and self.sentinel.enabled) or not rows:
+            return
+        import numpy as np
+
+        arr = np.asarray(rows, dtype=np.float64)
+        bad = ~np.isfinite(arr).all(axis=tuple(range(1, arr.ndim)))
+        n_bad = int(bad.sum())
+        if n_bad:
+            first_bad = arr[bad][0]
+            stats = {str(n): float(v) for n, v in zip(names, first_bad)}
+            self.on_update(step, stats, nonfinite=n_bad)
+
+    # -- fault injection (tests / chaos drills) ----------------------------
+    def maybe_inject_nan(self, iter_num: int, tree):
+        """Poison a train batch at the configured iteration
+        (``diagnostics.sentinel.inject_nan_iter``) — the documented way to
+        drill the sentinel path end-to-end without doctoring model code."""
+        inject = self.sentinel.inject_nan_iter
+        if inject is None or int(iter_num) != inject:
+            return tree
+        if self.journal is not None:
+            self.journal.write("fault_injection", iter_num=int(iter_num))
+        return poison_tree(tree)
+
+
+def build_diagnostics(cfg: Optional[Mapping[str, Any]]) -> Diagnostics:
+    """Construct the facade from a composed run config (never raises on a
+    missing ``diagnostics`` section — direct entrypoint callers like bench.py
+    simply get a disabled facade)."""
+    return Diagnostics(cfg)

@@ -1,0 +1,132 @@
+"""Journal analysis: the library behind ``tools/journal_report.py``.
+
+Everything a post-mortem needs without TensorBoard archaeology: run identity
+and config hash, the last logged step counter and metric values (including
+``Rewards/rew_avg``), checkpoint and divergence timelines, and a CSV export
+of the full metric history.  Works on journals from crashed runs — the reader
+already skips a truncated trailing line.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.diagnostics.journal import find_journal, read_journal
+
+
+def summarize(path: str) -> Dict[str, Any]:
+    """Summarize a journal file (or a run directory containing one)."""
+    journal_path = find_journal(path)
+    if journal_path is None:
+        raise FileNotFoundError(f"No journal.jsonl found under '{path}'")
+    events = read_journal(journal_path)
+    metrics_events = [e for e in events if e.get("event") == "metrics"]
+    checkpoints = [e for e in events if e.get("event") == "checkpoint"]
+    divergences = [e for e in events if e.get("event") == "divergence"]
+    run_start = next((e for e in events if e.get("event") == "run_start"), None)
+    run_end = next((e for e in reversed(events) if e.get("event") == "run_end"), None)
+
+    last_metrics = metrics_events[-1] if metrics_events else None
+    last_rew = None
+    last_rew_step = None
+    for e in reversed(metrics_events):
+        rew = (e.get("metrics") or {}).get("Rewards/rew_avg")
+        if isinstance(rew, (int, float)):
+            last_rew, last_rew_step = float(rew), e.get("step")
+            break
+
+    return {
+        "journal_path": journal_path,
+        "n_events": len(events),
+        "run_start": run_start,
+        "run_end": run_end,
+        # a journal without run_end is the signature of a killed run
+        "clean_shutdown": run_end is not None,
+        "n_metrics_events": len(metrics_events),
+        "last_step": last_metrics.get("step") if last_metrics else None,
+        "last_metrics": (last_metrics.get("metrics") or {}) if last_metrics else {},
+        "last_rew_avg": last_rew,
+        "last_rew_avg_step": last_rew_step,
+        "checkpoints": [{"step": e.get("step"), "path": e.get("path")} for e in checkpoints],
+        "divergences": divergences,
+    }
+
+
+def to_csv(path: str, out_path: str) -> int:
+    """Export the journal's metric history to CSV; returns the row count.
+
+    Columns: ``t``, ``step``, then the union of metric names over the run
+    (sorted).  Non-finite values survive as their journal string form
+    ("nan"/"inf") so spreadsheet greps for them still work.
+    """
+    journal_path = find_journal(path)
+    if journal_path is None:
+        raise FileNotFoundError(f"No journal.jsonl found under '{path}'")
+    rows: List[Dict[str, Any]] = []
+    keys: List[str] = []
+    seen = set()
+    for e in read_journal(journal_path):
+        if e.get("event") != "metrics":
+            continue
+        metrics = e.get("metrics") or {}
+        rows.append({"t": e.get("t"), "step": e.get("step"), **metrics})
+        for k in metrics:
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+    fieldnames = ["t", "step"] + sorted(keys)
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w", newline="", encoding="utf-8") as fp:
+        writer = csv.DictWriter(fp, fieldnames=fieldnames)
+        writer.writeheader()
+        writer.writerows(rows)
+    return len(rows)
+
+
+def format_summary(summary: Dict[str, Any]) -> str:
+    """Human-readable report (what the CLI prints)."""
+    lines = [f"journal: {summary['journal_path']}"]
+    start = summary.get("run_start") or {}
+    if start:
+        lines.append(
+            "run:     algo={algo} env={env} seed={seed} config_hash={config_hash}".format(
+                algo=start.get("algo", "?"),
+                env=start.get("env", "?"),
+                seed=start.get("seed", "?"),
+                config_hash=start.get("config_hash", "?"),
+            )
+        )
+    end = summary.get("run_end")
+    lines.append(
+        "status:  "
+        + (f"{end.get('status', 'unknown')} (clean shutdown)" if end else "NO run_end event — run was killed or is still going")
+    )
+    lines.append(f"events:  {summary['n_events']} total, {summary['n_metrics_events']} metric intervals")
+    if summary.get("last_step") is not None:
+        lines.append(f"last logged step: {summary['last_step']}")
+    if summary.get("last_rew_avg") is not None:
+        lines.append(
+            f"last Rewards/rew_avg: {summary['last_rew_avg']:.4f} (at step {summary['last_rew_avg_step']})"
+        )
+    if summary.get("last_metrics"):
+        lines.append("last metrics:")
+        for k, v in sorted(summary["last_metrics"].items()):
+            lines.append(f"  {k}: {v}")
+    ckpts = summary.get("checkpoints") or []
+    lines.append(f"checkpoints: {len(ckpts)}" + (f" (last at step {ckpts[-1]['step']})" if ckpts else ""))
+    divs = summary.get("divergences") or []
+    if divs:
+        lines.append(f"divergence events: {len(divs)}")
+        for d in divs[-5:]:
+            lines.append(
+                "  step {step}: {kind} {detail}".format(
+                    step=d.get("step", "?"),
+                    kind=d.get("kind", "?"),
+                    detail={k: v for k, v in d.items() if k not in ("t", "event", "step", "kind")},
+                )
+            )
+    else:
+        lines.append("divergence events: none")
+    return "\n".join(lines)

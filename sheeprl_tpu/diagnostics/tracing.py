@@ -1,0 +1,129 @@
+"""Step-phase tracing: Chrome-trace (Trace Event Format) span timers.
+
+Complements the existing whole-run ``jax.profiler`` gate (cfg.metric.profiler)
+which captures *device* activity: these spans time the **host-side phases** of
+the training loops — rollout, buffer-sample, train dispatch, checkpoint — and
+serialize them as Trace Event ``"X"`` (complete) events, one JSON object per
+line inside a streaming array.  Open the file in ``chrome://tracing`` or
+https://ui.perfetto.dev.
+
+Crash behaviour mirrors the journal: every event is flushed as written and
+the closing ``]`` only lands in :meth:`PhaseTracer.close` — both Chrome and
+Perfetto explicitly accept a truncated (unterminated) trace array, so a
+SIGKILL'd run still leaves a loadable trace.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+TRACE_NAME = "trace.json"
+
+
+class PhaseTracer:
+    """Streaming Trace-Event writer with a ``span`` context manager."""
+
+    def __init__(self, path: str, pid: int = 0, flush_every: int = 1):
+        self.path = str(path)
+        self._pid = int(pid)
+        self._flush_every = max(1, int(flush_every))
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        self._fp = open(self.path, "w", encoding="utf-8")
+        self._fp.write("[\n")
+        self._first = True
+        self._count = 0
+        self._closed = False
+        self._lock = threading.Lock()
+        # perf_counter origin so ts deltas are monotonic within the run
+        self._t0_ns = time.perf_counter_ns()
+        self._emit(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self._pid,
+                "tid": 0,
+                "args": {"name": f"sheeprl_tpu host {self._pid}"},
+            }
+        )
+
+    def _now_us(self) -> int:
+        return (time.perf_counter_ns() - self._t0_ns) // 1000
+
+    def _emit(self, event: Dict[str, Any]) -> None:
+        if self._closed:
+            return
+        with self._lock:
+            if not self._first:
+                self._fp.write(",\n")
+            self._first = False
+            self._fp.write(json.dumps(event, separators=(",", ":")))
+            self._count += 1
+            if self._count % self._flush_every == 0:
+                self._fp.flush()
+
+    @contextmanager
+    def span(self, name: str, **args: Any):
+        """Time a phase as a complete ("X") event."""
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            self._emit(
+                {
+                    "name": str(name),
+                    "cat": "phase",
+                    "ph": "X",
+                    "ts": start,
+                    "dur": max(0, self._now_us() - start),
+                    "pid": self._pid,
+                    "tid": threading.get_ident() % (1 << 31),
+                    **({"args": args} if args else {}),
+                }
+            )
+
+    def instant(self, name: str, **args: Any) -> None:
+        """Mark a point event (checkpoint written, divergence detected...)."""
+        self._emit(
+            {
+                "name": str(name),
+                "cat": "event",
+                "ph": "i",
+                "s": "g",  # global-scope instant: full-height line in the UI
+                "ts": self._now_us(),
+                "pid": self._pid,
+                "tid": threading.get_ident() % (1 << 31),
+                **({"args": args} if args else {}),
+            }
+        )
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._fp.write("\n]\n")
+            self._fp.flush()
+        except ValueError:  # pragma: no cover - interpreter teardown
+            pass
+        self._fp.close()
+
+
+class NullTracer:
+    """No-op stand-in when tracing is disabled or on non-zero ranks."""
+
+    path: Optional[str] = None
+
+    @contextmanager
+    def span(self, name: str, **args: Any):
+        yield
+
+    def instant(self, name: str, **args: Any) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

@@ -36,6 +36,10 @@ from sheeprl_tpu.parallel.precision import cast_floating
 
 
 class Runtime:
+    # Run-health facade (sheeprl_tpu/diagnostics): attached by the CLI before
+    # launch, or lazily by utils.get_diagnostics for direct entrypoint callers.
+    diagnostics = None
+
     def __init__(
         self,
         devices: int | str = 1,
@@ -195,6 +199,7 @@ def get_single_device_runtime(runtime: Runtime) -> Runtime:
     single.param_dtype = runtime.param_dtype
     single.compute_dtype = runtime.compute_dtype
     single.callbacks = runtime.callbacks
+    single.diagnostics = runtime.diagnostics
     single.mesh = make_mesh(n_devices=1, devices=[runtime.device])
     single._launched = True
     return single

@@ -149,7 +149,11 @@ class TPESampler:
         return {c: counts[c] / total for c in choices}
 
     def ask(self) -> Dict[str, Any]:
-        if len(self.observations) < self.n_startup:
+        # below 2 observations the good/bad split cannot be disjoint: the sole
+        # point would land in both sides and self-penalize (its l/g densities
+        # cancel), so the candidate scoring degenerates — stay on random
+        # sampling until a real split exists, whatever n_startup says
+        if len(self.observations) < max(2, self.n_startup):
             return {k: self.rng.choice(self.space[k]) for k in self.keys}
         ranked = sorted(self.observations, key=lambda o: o[1], reverse=True)
         n_good = max(1, int(math.ceil(self.gamma * len(ranked))))

@@ -140,7 +140,55 @@ def get_log_dir(runtime, root_dir: str, run_name: str, share: bool = True) -> st
         os.makedirs(log_dir, exist_ok=True)
     if share:
         log_dir = runtime.broadcast(log_dir)
+    # Run-health plumbing: every training loop resolves its run dir here, so
+    # opening the diagnostics journal here (idempotent, rank-0 gated) is what
+    # gives ALL algorithms — not just the loops with explicit hooks — a
+    # crash-safe journal under the CLI, which attaches the facade pre-launch.
+    diagnostics = getattr(runtime, "diagnostics", None)
+    if diagnostics is not None:
+        diagnostics.open(log_dir, rank_zero=runtime.is_global_zero)
     return log_dir
+
+
+class JournalingLogger(NoOpLogger):
+    """Transparent proxy that mirrors every ``log_metrics`` call into the
+    run-health journal (``sheeprl_tpu/diagnostics``).
+
+    This is the plumbing that gives *every* algorithm — not just the flagship
+    loops with explicit diagnostics hooks — a crash-safe record of each
+    aggregated metric interval: the journal captures exactly what the
+    TensorBoard/W&B backend received, at the moment it received it.  The
+    diagnostics facade is looked up lazily on the runtime because loggers are
+    created before the run dir (and hence the journal) exists; it no-ops
+    until the facade is opened, and only rank 0 ever holds an open journal.
+    """
+
+    def __init__(self, inner: NoOpLogger, runtime):
+        self._inner = inner
+        self._runtime = runtime
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def log_dir(self):
+        return self._inner.log_dir
+
+    @property
+    def name(self):
+        return self._inner.name
+
+    def log_metrics(self, metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+        self._inner.log_metrics(metrics, step)
+        diagnostics = getattr(self._runtime, "diagnostics", None)
+        if diagnostics is not None:
+            diagnostics.log_metrics(step, metrics)
+
+    def log_hyperparams(self, params: Dict[str, Any]) -> None:
+        self._inner.log_hyperparams(params)
+
+    def finalize(self, status: str = "success") -> None:
+        self._inner.finalize(status)
 
 
 def get_logger(runtime, cfg) -> NoOpLogger:
@@ -150,4 +198,4 @@ def get_logger(runtime, cfg) -> NoOpLogger:
     if not runtime.is_global_zero or cfg.metric.get("log_level", 1) == 0 or cfg.metric.get("logger") is None:
         return NoOpLogger()
     logger_cfg = dict(cfg.metric.logger)
-    return instantiate(logger_cfg)
+    return JournalingLogger(instantiate(logger_cfg), runtime)

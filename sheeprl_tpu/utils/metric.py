@@ -196,9 +196,16 @@ class DeviceMetricsDrain:
             self._rows.extend(np.asarray(jnp.stack(self._pending)))
             self._pending.clear()
 
-    def flush_into(self, aggregator: "MetricAggregator", metric_order) -> None:
-        """Fetch everything pending and feed the named aggregator."""
+    def flush_into(self, aggregator: "MetricAggregator", metric_order, observer=None) -> None:
+        """Fetch everything pending and feed the named aggregator.
+
+        ``observer(rows)``, when given, sees the raw per-gradient-step metric
+        rows *before* NaN filtering — the diagnostics sentinel uses this to
+        detect non-finite train steps that the aggregator would silently drop
+        at compute time."""
         self._drain()
+        if observer is not None and self._rows:
+            observer(list(self._rows))
         for row in self._rows:
             for name, value in zip(metric_order, row):
                 aggregator.update(name, float(value))

@@ -167,6 +167,25 @@ def nest_dotted(flat: Mapping[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def get_diagnostics(runtime, cfg: Mapping[str, Any], log_dir: str):
+    """Return the run's opened :class:`~sheeprl_tpu.diagnostics.Diagnostics`.
+
+    The CLI attaches a facade to the runtime before launch; entrypoints
+    invoked directly (search harness, benchmarks, tests) get one built here
+    from their own ``cfg``.  Opening is idempotent and rank-0 gated, so every
+    training loop can call this right after ``get_log_dir`` and use the hooks
+    unconditionally.
+    """
+    from sheeprl_tpu.diagnostics import build_diagnostics
+
+    diag = getattr(runtime, "diagnostics", None)
+    if diag is None:
+        diag = build_diagnostics(cfg)
+        runtime.diagnostics = diag
+    diag.open(log_dir, rank_zero=runtime.is_global_zero)
+    return diag
+
+
 def unbind_parameters(tree):
     """No-op placeholder mirroring the reference's ``unwrap_fabric``: parameters
     in JAX are plain pytrees of arrays, there is nothing to unwrap."""

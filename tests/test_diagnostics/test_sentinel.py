@@ -1,0 +1,210 @@
+"""Unit tests for the NaN/divergence sentinel: the jit-compatible guard
+helpers, the policy behaviours at the facade level, and the host-side rolling
+divergence detector."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.diagnostics import SentinelHalt, build_diagnostics
+from sheeprl_tpu.diagnostics.journal import read_journal
+from sheeprl_tpu.diagnostics.sentinel import (
+    DivergenceDetector,
+    finite_flag,
+    poison_tree,
+    select_finite,
+    sentinel_spec,
+)
+
+
+def _diag_cfg(policy: str, inject=None):
+    return {
+        "diagnostics": {
+            "enabled": True,
+            "journal": {"enabled": True},
+            "sentinel": {
+                "enabled": True,
+                "policy": policy,
+                "inject_nan_iter": inject,
+                "divergence": {"enabled": False},
+            },
+            "trace": {"enabled": False},
+        },
+        "algo": {"name": "t"},
+        "env": {"id": "t"},
+    }
+
+
+# -- jit-compatible helpers -------------------------------------------------
+
+
+def test_finite_flag_under_jit():
+    @jax.jit
+    def check(a, b):
+        return finite_flag(a, b)
+
+    assert bool(check(jnp.float32(1.0), jnp.float32(-2.0)))
+    assert not bool(check(jnp.float32(jnp.nan), jnp.float32(1.0)))
+    assert not bool(check(jnp.float32(1.0), jnp.float32(jnp.inf)))
+
+
+def test_select_finite_discards_nan_update_under_jit():
+    params = {"w": jnp.ones((3,)), "b": jnp.zeros((2,))}
+    bad = {"w": jnp.full((3,), jnp.nan), "b": jnp.ones((2,))}
+
+    @jax.jit
+    def guarded(new, old):
+        return select_finite(finite_flag(optax.global_norm(new)), new, old)
+
+    kept = guarded(bad, params)
+    np.testing.assert_array_equal(np.asarray(kept["w"]), np.ones(3))
+    np.testing.assert_array_equal(np.asarray(kept["b"]), np.zeros(2))
+    good = {"w": jnp.full((3,), 2.0), "b": jnp.ones((2,))}
+    taken = guarded(good, params)
+    np.testing.assert_array_equal(np.asarray(taken["w"]), np.full(3, 2.0))
+
+
+def test_guarded_optimizer_step_skips_nan_grads():
+    """The exact pattern the train steps use: NaN grads -> old params/opt
+    state survive; finite grads -> the update applies."""
+    optimizer = optax.adam(1e-1)
+    params = {"w": jnp.ones((4,))}
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state, grads):
+        gnorm = optax.global_norm(grads)
+        finite = finite_flag(gnorm)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        params = select_finite(finite, new_params, params)
+        opt_state = select_finite(finite, new_opt_state, opt_state)
+        return params, opt_state, 1.0 - finite.astype(jnp.float32)
+
+    nan_grads = {"w": jnp.full((4,), jnp.nan)}
+    p1, o1, nonfinite = step(params, opt_state, nan_grads)
+    assert float(nonfinite) == 1.0
+    np.testing.assert_array_equal(np.asarray(p1["w"]), np.ones(4))
+    assert np.isfinite(np.asarray(jax.tree_util.tree_leaves(o1)[0])).all()
+
+    good_grads = {"w": jnp.ones((4,))}
+    p2, _, nonfinite = step(p1, o1, good_grads)
+    assert float(nonfinite) == 0.0
+    assert not np.array_equal(np.asarray(p2["w"]), np.ones(4))
+
+
+def test_poison_tree_only_touches_floats():
+    tree = {"f": jnp.ones((2,)), "i": jnp.array([1, 2], jnp.int32)}
+    poisoned = poison_tree(tree)
+    assert np.isnan(np.asarray(poisoned["f"])).all()
+    np.testing.assert_array_equal(np.asarray(poisoned["i"]), [1, 2])
+
+
+def test_sentinel_spec_parsing():
+    spec = sentinel_spec(_diag_cfg("skip_update", inject=3))
+    assert spec.enabled and spec.skip_update and spec.inject_nan_iter == 3
+    assert not sentinel_spec({}).enabled  # partial configs (bench, HLO tests)
+    with pytest.raises(ValueError):
+        sentinel_spec(_diag_cfg("explode"))
+
+
+# -- facade policies --------------------------------------------------------
+
+
+def test_policy_warn_journals_and_warns(tmp_path):
+    diag = build_diagnostics(_diag_cfg("warn"))
+    diag.open(str(tmp_path))
+    with pytest.warns(RuntimeWarning, match="non-finite"):
+        diag.on_update(32, {"Loss/policy_loss": float("nan")}, nonfinite=2.0)
+    diag.close()
+    events = read_journal(str(tmp_path / "journal.jsonl"))
+    (div,) = [e for e in events if e["event"] == "divergence"]
+    assert div["kind"] == "nonfinite_update"
+    assert div["nonfinite_steps"] == 2.0
+    assert div["policy"] == "warn"
+    assert div["Loss/policy_loss"] == "nan"
+
+
+def test_policy_halt_raises_after_journaling(tmp_path):
+    diag = build_diagnostics(_diag_cfg("halt"))
+    diag.open(str(tmp_path))
+    with pytest.raises(SentinelHalt):
+        diag.on_update(8, {"Grads/global_norm": float("inf")}, nonfinite=1.0)
+    events = read_journal(str(tmp_path / "journal.jsonl"))
+    kinds = [e["event"] for e in events]
+    assert "divergence" in kinds
+    assert events[-1] == {**events[-1], "event": "run_end", "status": "halted"}
+
+
+def test_finite_updates_do_not_journal(tmp_path):
+    diag = build_diagnostics(_diag_cfg("halt"))
+    diag.open(str(tmp_path))
+    diag.on_update(8, {"Loss/policy_loss": 0.1}, nonfinite=0.0)
+    diag.close()
+    assert not [e for e in read_journal(str(tmp_path / "journal.jsonl")) if e["event"] == "divergence"]
+
+
+def test_observe_rows_counts_bad_gradient_steps(tmp_path):
+    """The Dreamer drain path: raw per-gradient-step metric rows, some NaN."""
+    diag = build_diagnostics(_diag_cfg("warn"))
+    diag.open(str(tmp_path))
+    rows = [np.array([0.1, 0.2]), np.array([np.nan, 0.2]), np.array([0.3, np.inf])]
+    with pytest.warns(RuntimeWarning):
+        diag.observe_rows(64, ["Loss/a", "Loss/b"], rows)
+    (div,) = [e for e in read_journal(str(tmp_path / "journal.jsonl")) if e["event"] == "divergence"]
+    assert div["nonfinite_steps"] == 2.0
+    diag.close()
+
+
+def test_maybe_inject_nan_fires_once(tmp_path):
+    diag = build_diagnostics(_diag_cfg("skip_update", inject=2))
+    diag.open(str(tmp_path))
+    clean = {"x": jnp.ones((2,))}
+    assert diag.maybe_inject_nan(1, clean) is clean
+    assert np.isnan(np.asarray(diag.maybe_inject_nan(2, clean)["x"])).all()
+    assert diag.maybe_inject_nan(3, clean) is clean
+    diag.close()
+    assert [e["event"] for e in read_journal(str(tmp_path / "journal.jsonl"))].count("fault_injection") == 1
+
+
+# -- host-side divergence detector ------------------------------------------
+
+
+def test_detector_loss_explosion():
+    detector = DivergenceDetector(window=10, min_points=3, loss_explosion_ratio=10.0)
+    for step, v in enumerate([1.0, 1.1, 0.9, 1.0]):
+        assert detector.observe(step, {"Loss/value_loss": v}) == []
+    (event,) = detector.observe(5, {"Loss/value_loss": 50.0})
+    assert event["kind"] == "loss_explosion"
+    assert event["metric"] == "Loss/value_loss"
+    assert event["ratio"] == pytest.approx(50.0, rel=0.2)
+
+
+def test_detector_entropy_floor():
+    # magnitude floor: works for negative-entropy (Loss/entropy_loss) and
+    # true-entropy conventions alike, since collapse drives both toward 0
+    detector = DivergenceDetector(entropy_key="Loss/entropy_loss", entropy_floor=0.1)
+    assert detector.observe(1, {"Loss/entropy_loss": -0.6}) == []
+    (event,) = detector.observe(2, {"Loss/entropy_loss": -0.01})
+    assert event["kind"] == "entropy_collapse"
+    assert event["floor"] == 0.1
+    detector2 = DivergenceDetector(entropy_key="State/post_entropy", entropy_floor=0.1)
+    assert detector2.observe(1, {"State/post_entropy": 0.8}) == []
+    (event2,) = detector2.observe(2, {"State/post_entropy": 0.02})
+    assert event2["kind"] == "entropy_collapse"
+
+
+def test_detector_nonfinite_metric():
+    detector = DivergenceDetector()
+    (event,) = detector.observe(1, {"Loss/policy_loss": float("nan")})
+    assert event["kind"] == "nonfinite_metric"
+
+
+def test_detector_ignores_unwatched_keys():
+    detector = DivergenceDetector(window=5, min_points=2, loss_explosion_ratio=2.0)
+    for step, v in enumerate([1.0, 1.0, 1000.0]):
+        assert detector.observe(step, {"Time/sps_train": v}) == []

@@ -1,0 +1,86 @@
+"""Unit tests for the Chrome-trace phase tracer: well-formed Trace Event JSON
+after close, loadable (truncated-array) output after a crash, and facade
+gating."""
+
+from __future__ import annotations
+
+import json
+
+from sheeprl_tpu.diagnostics import build_diagnostics
+from sheeprl_tpu.diagnostics.tracing import PhaseTracer
+
+
+def test_trace_is_valid_json_with_complete_events(tmp_path):
+    path = tmp_path / "trace.json"
+    tracer = PhaseTracer(str(path), pid=0)
+    with tracer.span("rollout"):
+        with tracer.span("train", iter=1):
+            pass
+    tracer.instant("checkpoint", step=16)
+    tracer.close()
+
+    events = json.loads(path.read_text())
+    assert isinstance(events, list)
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert [e["name"] for e in spans] == ["train", "rollout"]  # inner closes first
+    for e in spans:
+        assert e["cat"] == "phase"
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int) and e["dur"] >= 0
+        assert "pid" in e and "tid" in e
+    # nesting: the inner span lies within the outer one on the timeline
+    train, rollout = spans
+    assert rollout["ts"] <= train["ts"]
+    assert train["ts"] + train["dur"] <= rollout["ts"] + rollout["dur"]
+    (mark,) = [e for e in events if e.get("ph") == "i"]
+    assert mark["name"] == "checkpoint" and mark["args"]["step"] == 16
+
+
+def test_crashed_trace_is_still_loadable(tmp_path):
+    """No close(): the file is an unterminated array (what a SIGKILL leaves).
+    Chrome/Perfetto accept that; appending ']' must yield valid JSON."""
+    path = tmp_path / "trace.json"
+    tracer = PhaseTracer(str(path), pid=0)
+    with tracer.span("rollout"):
+        pass
+    tracer._fp.flush()
+    raw = path.read_text()
+    assert not raw.rstrip().endswith("]")
+    events = json.loads(raw + "]")
+    assert any(e.get("name") == "rollout" for e in events)
+
+
+def test_facade_creates_trace_next_to_journal(tmp_path):
+    diag = build_diagnostics(
+        {
+            "diagnostics": {
+                "enabled": True,
+                "journal": {"enabled": True},
+                "sentinel": {"enabled": False},
+                "trace": {"enabled": True},
+            },
+            "algo": {"name": "t"},
+            "env": {"id": "t"},
+        }
+    )
+    diag.open(str(tmp_path))
+    with diag.span("rollout"):
+        pass
+    diag.close()
+    events = json.loads((tmp_path / "trace.json").read_text())
+    assert any(e.get("name") == "rollout" for e in events)
+    assert (tmp_path / "journal.jsonl").exists()
+
+
+def test_trace_disabled_by_default(tmp_path):
+    diag = build_diagnostics(
+        {
+            "diagnostics": {"enabled": True, "journal": {"enabled": True}},
+            "algo": {"name": "t"},
+            "env": {"id": "t"},
+        }
+    )
+    diag.open(str(tmp_path))
+    with diag.span("rollout"):
+        pass
+    diag.close()
+    assert not (tmp_path / "trace.json").exists()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Summarize or export a run-health journal (``journal.jsonl``).
+
+The journal is the crash-safe record every training run writes next to its
+checkpoints (see ``howto/diagnostics.md``): after a SIGKILL'd run this tool
+reproduces the last logged metrics — including ``Rewards/rew_avg`` — and the
+step counter without touching TensorBoard event files.
+
+Usage:
+    python tools/journal_report.py logs/runs/ppo/CartPole-v1/<run>/
+    python tools/journal_report.py path/to/journal.jsonl --csv rewards.csv
+    python tools/journal_report.py <run dir> --json        # machine-readable
+
+Accepts a journal file, a ``version_N`` directory, or any run-dir ancestor
+(the newest journal below wins).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable straight from a checkout: tools/ is not a package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.diagnostics.report import format_summary, summarize, to_csv  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="journal.jsonl, a version_N dir, or a run dir")
+    parser.add_argument("--csv", metavar="OUT", help="export the metric history to OUT as CSV")
+    parser.add_argument("--json", action="store_true", help="print the summary as JSON instead of text")
+    args = parser.parse_args()
+
+    try:
+        summary = summarize(args.path)
+    except FileNotFoundError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(format_summary(summary))
+
+    if args.csv:
+        n = to_csv(args.path, args.csv)
+        print(f"\nwrote {n} metric rows to {args.csv}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

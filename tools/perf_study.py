@@ -70,6 +70,13 @@ def measure_xl_levers(
     block) so congestion episodes hit all variants equally.  Reports medians
     of per-block step times.
 
+    HBM note: interleaving is not free — all three variants' params +
+    optimizer states (+ one compiled executable each) stay resident
+    simultaneously, so expect roughly 3x the model-state HBM of a single run;
+    size the batch accordingly before pointing this at a real chip.  The
+    input batch itself is built once and shared across the variants (the
+    levers change compilation, not shapes), so it does not triple.
+
     - ``fused_gru``: Pallas fused LayerNorm-GRU at the XL recurrent width
       (4096 hidden, 5632-wide joint input) vs XLA fusion — round-2 measured
       XLA faster at S shapes (512); the XL GEMM shape changes the tradeoff.
@@ -90,6 +97,7 @@ def measure_xl_levers(
         "unroll8": ["algo.scan_unroll=8"],
     }
     built = {}
+    shared_batch = None
     for name, extra in variants.items():
         _, train_step, state, batch = build_train_step_and_batch(
             precision,
@@ -98,11 +106,21 @@ def measure_xl_levers(
             sequence_length=seq_len,
             extra_overrides=extra,
         )
+        if shared_batch is None:
+            shared_batch = batch  # identical shapes across variants: keep ONE copy in HBM
+        else:
+            # drop this variant's freshly built duplicate immediately instead
+            # of waiting for GC — at XL shapes the batch is HBM that the
+            # third variant's compile may need
+            for leaf in jax.tree_util.tree_leaves(batch):
+                leaf.delete()
+        del batch
         state["key"] = jax.random.PRNGKey(0)
-        built[name] = (train_step, batch, state)
+        built[name] = (train_step, state)
 
     def block(name) -> float:
-        train_step, batch, state = built[name]
+        train_step, state = built[name]
+        batch = shared_batch
         t0 = time.perf_counter()
         for _ in range(block_steps):
             state["key"], sub = jax.random.split(state["key"])
