@@ -399,6 +399,97 @@ def measure_e2e(
     }
 
 
+def measure_env_overlap(
+    precision: str,
+    sleep_ms: float = 80.0,
+    iters: int = 25,
+    warmup_iters: int = 3,
+    size: str = "XS",
+    batch_size: int = 4,
+    sequence_length: int = 16,
+):
+    """Within-run serialized-vs-pipelined env-overlap pair (ISSUE 2).
+
+    One compiled DV3 train step + one ``sleep_ms`` dummy env through the
+    split-phase ``PipelinedVectorEnv`` layer.  ``serialized`` steps the env,
+    then dispatches the gradient step and fetches its metrics (the reference
+    order); ``pipelined`` issues ``step_async``, dispatches + fetches, and
+    only then ``step_wait``s — the env's wall-clock hides behind the train
+    dispatch and the blocking metric fetch.  Same graphs, same env, same
+    process, back to back, so the tunnel's congestion drift (PERF.md §1)
+    cancels within the pair; every timing uses the value-fetch barrier
+    discipline of PERF.md §6.  The deterministic ``sleep_ms`` makes the
+    expected gap exact: serialized ≈ pipelined + sleep_ms per iteration.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+    from sheeprl_tpu.envs.env import vectorized_env
+    from sheeprl_tpu.envs.pipeline import PipelinedVectorEnv
+
+    _, train_step, state, batch = build_train_step_and_batch(
+        precision,
+        size=size,
+        batch_size=batch_size,
+        sequence_length=sequence_length,
+        extra_overrides=[
+            "algo.cnn_keys.encoder=[]",
+            "algo.cnn_keys.decoder=[]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.mlp_keys.decoder=[state]",
+        ],
+    )
+    params, opt_states, moments_state = state["params"], state["opt_states"], state["moments_state"]
+    key = jax.random.PRNGKey(0)
+    tau = jnp.float32(0.02)
+
+    def mk():
+        return DiscreteDummyEnv(n_steps=1_000_000, image_size=(3, 8, 8), sleep_ms=sleep_ms)
+
+    envs = PipelinedVectorEnv(vectorized_env([mk], sync=True))
+    envs.reset(seed=0)
+    actions = np.zeros(1, np.int64)
+
+    def one_iter(pipelined, params, opt_states, moments_state, key):
+        key, sub = jax.random.split(key)
+        if not pipelined:
+            envs.step(actions)
+        else:
+            envs.step_async(actions)
+        params, opt_states, moments_state, metrics = train_step(
+            params, opt_states, moments_state, batch, sub, tau
+        )
+        _ = np.asarray(metrics)  # per-iter value barrier (PERF.md §6)
+        if pipelined:
+            envs.step_wait()
+        return params, opt_states, moments_state, key
+
+    results = {}
+    for mode, pipelined in (("serialized", False), ("pipelined", True)):
+        for _ in range(warmup_iters):
+            params, opt_states, moments_state, key = one_iter(
+                pipelined, params, opt_states, moments_state, key
+            )
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_states, moments_state, key = one_iter(
+                pipelined, params, opt_states, moments_state, key
+            )
+        results[f"grad_steps_per_sec_env_{mode}"] = round(iters / (time.perf_counter() - t0), 3)
+    envs.close()
+    return {
+        **results,
+        "env_overlap_workload": (
+            f"DV3-{size} vector obs, batch {batch_size} x seq {sequence_length}, "
+            f"1 dummy env sleep_ms={sleep_ms:g}, thread-backed PipelinedVectorEnv"
+        ),
+        "env_sleep_ms": sleep_ms,
+        "env_overlap_iters": iters,
+    }
+
+
 def measure_fetch_rtt():
     """Blocking value-fetch round trip of the device link (through the axon
     tunnel this is ~90-110 ms and dominates the e2e loop's critical path; on
@@ -528,6 +619,15 @@ def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
     if e2e_4env:
         record["grad_steps_per_sec_e2e_4env"] = e2e_4env["grad_steps_per_sec_e2e_pipelined"]
         record["grad_steps_per_sec_e2e_4env_serialized"] = e2e_4env["grad_steps_per_sec_e2e_serialized"]
+
+    # split-phase env pipeline pair (ISSUE 2): same compiled step + same env,
+    # serialized vs step_async/step_wait, within one run so tunnel drift
+    # cancels; fetch_rtt_ms above carries the pair's tunnel context
+    env_overlap = stage("env_overlap", 240, lambda: measure_env_overlap(precision))
+    if env_overlap:
+        record["grad_steps_per_sec_env_serialized"] = env_overlap["grad_steps_per_sec_env_serialized"]
+        record["grad_steps_per_sec_env_pipelined"] = env_overlap["grad_steps_per_sec_env_pipelined"]
+        record.update({k: v for k, v in env_overlap.items() if not k.startswith("grad_steps")})
 
     # north-star config (BASELINE.md §C): XL single-chip compute + MFU, at the
     # reference batch (16) and at the MXU-saturating batch (64)

@@ -26,7 +26,7 @@ from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
 from sheeprl_tpu.algos.a2c.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.ops.numerics import gae
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -83,7 +83,7 @@ def make_train_step(agent, optimizer, cfg, mesh):
         return params, opt_state, jnp.stack([*aux, gnorm, 1.0 - finite.astype(jnp.float32)])
 
     if distributed:
-        from jax import shard_map
+        from sheeprl_tpu.parallel.compat import shard_map
 
         def sharded(params, opt_state, data):
             return shard_map(
@@ -121,10 +121,7 @@ def main(runtime, cfg):
         aggregator.disabled = True
     timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
 
-    envs = vectorized_env(
-        [make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i) for i in range(num_envs)],
-        sync=cfg.env.sync_env,
-    )
+    envs = pipelined_vector_env(cfg, make_env_fns(cfg, log_dir, "train"))
     observation_space = envs.single_observation_space
     action_space = envs.single_action_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -215,7 +212,18 @@ def main(runtime, cfg):
                 else:
                     env_actions = actions_np[:, 0].astype(np.int64)
 
-                next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                # split-phase: env workers step while the host copies the
+                # policy outputs + current obs into the step record (see
+                # ppo.py — trajectories are identical to the serialized order)
+                with diag.span("env_step_async"):
+                    envs.step_async(env_actions)
+                step_data: Dict[str, np.ndarray] = {}
+                for k in obs_keys:
+                    step_data[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[1:])
+                step_data["actions"] = actions_np.reshape(1, num_envs, -1)
+                step_data["values"] = np.asarray(values).reshape(1, num_envs, -1)
+                with diag.span("env_wait"):
+                    next_obs, rewards, terminated, truncated, info = envs.step_wait()
                 dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
                 rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
                 if cfg.env.clip_rewards:
@@ -229,11 +237,6 @@ def main(runtime, cfg):
                     vals = np.asarray(value_step(params, t_obs))
                     rewards[trunc_idx] += cfg.algo.gamma * vals.reshape(-1, 1)
 
-                step_data: Dict[str, np.ndarray] = {}
-                for k in obs_keys:
-                    step_data[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[1:])
-                step_data["actions"] = actions_np.reshape(1, num_envs, -1)
-                step_data["values"] = np.asarray(values).reshape(1, num_envs, -1)
                 step_data["rewards"] = rewards.reshape(1, num_envs, -1)
                 step_data["dones"] = dones.reshape(1, num_envs, -1)
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)

@@ -12,7 +12,6 @@ the optional `EpisodeBuffer` data source.
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict, Sequence
 
 import gymnasium as gym
@@ -32,8 +31,7 @@ from sheeprl_tpu.algos.dreamer_v2.utils import (  # noqa: F401
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
-from sheeprl_tpu.envs.env import make_env, vectorized_env
-from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.ops.distributions import Bernoulli
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, train_batches, local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
@@ -294,13 +292,7 @@ def main(runtime, cfg):
 
     rng_key = runtime.seed_everything(cfg.seed)
 
-    envs = vectorized_env(
-        [
-            partial(RestartOnException, make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i))
-            for i in range(num_envs)
-        ],
-        sync=cfg.env.sync_env,
-    )
+    envs = pipelined_vector_env(cfg, make_env_fns(cfg, log_dir, "train"))
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
     is_continuous = isinstance(action_space, gym.spaces.Box)

@@ -45,8 +45,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
-from sheeprl_tpu.envs.env import make_env, vectorized_env
-from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.envs.env import make_env_fns, pipelined_vector_env
 from sheeprl_tpu.ops.distributions import (
     Bernoulli,
     MSEDistribution,
@@ -476,15 +475,7 @@ def _dreamer_main(
 
     rng_key = runtime.seed_everything(cfg.seed)
 
-    from functools import partial
-
-    envs = vectorized_env(
-        [
-            partial(RestartOnException, make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i))
-            for i in range(num_envs)
-        ],
-        sync=cfg.env.sync_env,
-    )
+    envs = pipelined_vector_env(cfg, make_env_fns(cfg, log_dir, "train"))
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
     is_continuous = isinstance(action_space, gym.spaces.Box)
@@ -613,14 +604,22 @@ def _dreamer_main(
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
 
-        # ---- policy forward + replay write (dispatch; fetch deferred) -----
-        # Pipelined iteration: the player forward is *dispatched*, the step is
-        # written into the replay buffer (device-resident actions stay on
-        # device), this iteration's gradient steps are dispatched, and only
-        # THEN is the action value fetched for `envs.step` — so the fetch's
-        # tunnel round trip and the host-side env stepping both overlap the
-        # device executing the gradient steps (reference hot loop
-        # dreamer_v3.py:637-672 serializes these).
+        # ---- policy forward + env dispatch + replay write -----------------
+        # Split-phase iteration: the player forward is dispatched, its action
+        # values are fetched, and `step_async` is issued THE MOMENT the
+        # values land — the env workers then step
+        # concurrently with everything below: the replay write, the sampling
+        # + dispatch of this iteration's gradient steps, and the device
+        # executing them.  Only `step_wait` (after the train dispatch) blocks
+        # on the envs, so the per-iteration critical path is
+        # ``fwd + fetch + max(train dispatch, env_step)`` instead of the
+        # reference hot loop's full serialization (dreamer_v3.py:637-672).
+        # Ordering tradeoff: the gradient-step dispatch (~ms of host work)
+        # can hide behind either the action fetch (the pre-pipeline order) or
+        # the env step (this order) but not both — the fetch's tunnel copy is
+        # started at the same point either way, so the swing is only the host
+        # dispatch time, and this order wins whenever env_step exceeds it
+        # (every real simulator; bench.py's env_overlap pair measures it).
         with timer("Time/env_interaction_time"), diag.span("rollout"):
             actions_jnp = None
             if iter_num <= learning_starts and not cfg.checkpoint.resume_from:
@@ -645,39 +644,31 @@ def _dreamer_main(
                     mask=mask,
                 )
                 if use_device_buffer:
+                    # device-resident actions go straight into the HBM ring
+                    # (no fetch needed for the write)
                     step_data["actions"] = jnp.reshape(actions_jnp, (1, num_envs, -1))
-                elif np.any(rb.empty):
-                    # an empty (sub-)buffer cannot defer its first row past
-                    # the gradient-step sampling below (learning_starts=0
-                    # configs) — fall back to fetch-then-add for this step
-                    actions = np.asarray(actions_jnp)
-                    actions_jnp = None
-                    real_actions = split_real_actions(actions)
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                actions = np.asarray(actions_jnp)  # blocking value fetch
+                real_actions = split_real_actions(actions)
+                if not use_device_buffer:
                     step_data["actions"] = actions.reshape(1, num_envs, -1)
-            if actions_jnp is None or use_device_buffer:
+            with diag.span("env_step_async"):
+                envs.step_async(real_actions.reshape(envs.action_space.shape))
+            if actions_jnp is None or not use_device_buffer:
+                # prefill / host-buffer write — overlaps the env workers
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
-            if actions_jnp is not None:
-                # start the device->host copy NOW: it proceeds while the
-                # gradient steps below are dispatched, so the blocking fetch
-                # before `envs.step` finds the values already (or nearly)
-                # landed instead of paying the full tunnel round trip there.
-                # Host-buffer mode pipelines the same way: the numpy write
-                # into the buffer needs the fetched values, so the add is
-                # deferred with the fetch — this iteration's gradient steps
-                # sample everything up to the PREVIOUS policy step (one row
-                # less than the device path; bounded, like the reset-row lag
-                # documented below).
-                actions_jnp.copy_to_host_async()
 
         # ---- dispatch this iteration's gradient steps ---------------------
-        # The sample includes everything up to and including the current
-        # policy step; episode-end bookkeeping rows from *this* step (known
-        # only after `envs.step`) become sampleable one iteration later.
-        # Likewise the restart_on_exception truncation surgery (below) lands
-        # only after these gradient steps have sampled, so a crashed-env
-        # discontinuity can be trained on once as a normal transition — rare
-        # and bounded to one iteration (the reference patches before
-        # training; we accept the lag as the price of the overlap).
+        # Runs while the env workers are stepping.  The sample includes
+        # everything up to and including the current policy step (both buffer
+        # modes — the add above always precedes the sampling); episode-end
+        # bookkeeping rows from *this* step (known only at `step_wait`)
+        # become sampleable one iteration later.  Likewise the
+        # restart_on_exception truncation surgery (below) lands only after
+        # these gradient steps have sampled, so a crashed-env discontinuity
+        # can be trained on once as a normal transition — rare and bounded to
+        # one iteration (the reference patches before training; we accept the
+        # lag as the price of the overlap).
         if iter_num >= learning_starts:
             per_rank_gradient_steps = ratio(
                 (policy_step_count - prefill_steps * policy_steps_per_iter)
@@ -716,21 +707,9 @@ def _dreamer_main(
                     train_step_count += 1
                 metrics_drain.append(metrics)
 
-        # ---- fetch the actions, step the envs (device keeps training) -----
-        with timer("Time/env_interaction_time"), diag.span("rollout"):
-            if actions_jnp is not None:
-                actions = np.asarray(actions_jnp)
-                real_actions = split_real_actions(actions)
-                if not use_device_buffer:
-                    # deferred host-buffer write (see the pipelining note
-                    # above): the fetched values land in the numpy ring here,
-                    # after this iteration's gradient steps were dispatched
-                    step_data["actions"] = actions.reshape(1, num_envs, -1)
-                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
-            next_obs, rewards, terminated, truncated, infos = envs.step(
-                real_actions.reshape(envs.action_space.shape)
-            )
+        # ---- collect the env step results (device keeps training) --------
+        with timer("Time/env_interaction_time"), diag.span("env_wait"):
+            next_obs, rewards, terminated, truncated, infos = envs.step_wait()
             dones = np.logical_or(terminated, truncated).astype(np.uint8)
 
         step_data["is_first"] = np.zeros_like(step_data["terminated"])

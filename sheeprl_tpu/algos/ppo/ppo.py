@@ -32,7 +32,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.ops.numerics import gae
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -135,7 +135,7 @@ def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, batch_siz
         return params, opt_state, metrics
 
     if distributed:
-        from jax import shard_map
+        from sheeprl_tpu.parallel.compat import shard_map
 
         def sharded_update(params, opt_state, data, key, coefs):
             # per-device independent permutation: fold the axis index into the key
@@ -190,14 +190,8 @@ def main(runtime, cfg):
         aggregator.disabled = True
     timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
 
-    # ---- envs (reference ppo.py:137-150) ---------------------------------
-    envs = vectorized_env(
-        [
-            make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
-            for i in range(num_envs)
-        ],
-        sync=cfg.env.sync_env,
-    )
+    # ---- envs (reference ppo.py:137-150; split-phase pipeline layer) -----
+    envs = pipelined_vector_env(cfg, make_env_fns(cfg, log_dir, "train"))
     observation_space = envs.single_observation_space
     action_space = envs.single_action_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -326,7 +320,21 @@ def main(runtime, cfg):
                 else:
                     env_actions = actions_np[:, 0].astype(np.int64)
 
-                next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                # split-phase: the env workers step while this process copies
+                # the policy outputs + current obs into the step record — the
+                # per-step critical path is max(env_step, host copies) instead
+                # of their sum (trajectories are bit-for-bit the serialized
+                # order's: nothing the env sees changed, only when we wait)
+                with diag.span("env_step_async"):
+                    envs.step_async(env_actions)
+                step_data: Dict[str, np.ndarray] = {}
+                for k in obs_keys:
+                    step_data[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[1:])
+                step_data["actions"] = actions_np.reshape(1, num_envs, -1)
+                step_data["logprobs"] = np.asarray(logprobs).reshape(1, num_envs, -1)
+                step_data["values"] = np.asarray(values).reshape(1, num_envs, -1)
+                with diag.span("env_wait"):
+                    next_obs, rewards, terminated, truncated, info = envs.step_wait()
                 dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
                 rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, 1)
                 if cfg.env.clip_rewards:
@@ -344,12 +352,6 @@ def main(runtime, cfg):
                     vals = np.asarray(value_step(params, t_obs))
                     rewards[trunc_idx] += cfg.algo.gamma * vals.reshape(-1, 1)
 
-                step_data: Dict[str, np.ndarray] = {}
-                for k in obs_keys:
-                    step_data[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[1:])
-                step_data["actions"] = actions_np.reshape(1, num_envs, -1)
-                step_data["logprobs"] = np.asarray(logprobs).reshape(1, num_envs, -1)
-                step_data["values"] = np.asarray(values).reshape(1, num_envs, -1)
                 step_data["rewards"] = rewards.reshape(1, num_envs, -1)
                 step_data["dones"] = dones.reshape(1, num_envs, -1)
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)

@@ -33,7 +33,7 @@ from sheeprl_tpu.algos.ppo_recurrent.utils import (  # noqa: F401
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.ops.numerics import gae
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -102,7 +102,7 @@ def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, seq_batch
         return params, opt_state, jnp.mean(losses.reshape(-1, 3), axis=0)
 
     if distributed:
-        from jax import shard_map
+        from sheeprl_tpu.parallel.compat import shard_map
 
         def sharded(params, opt_state, data, key, coefs):
             def body(params, opt_state, data, key, coefs):
@@ -155,10 +155,7 @@ def main(runtime, cfg):
         aggregator.disabled = True
     timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
 
-    envs = vectorized_env(
-        [make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i) for i in range(num_envs)],
-        sync=cfg.env.sync_env,
-    )
+    envs = pipelined_vector_env(cfg, make_env_fns(cfg, log_dir, "train"))
     observation_space = envs.single_observation_space
     action_space = envs.single_action_space
     if not isinstance(observation_space, gym.spaces.Dict):

@@ -27,7 +27,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.parallel.dp import local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -156,7 +156,7 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
         return params, opt_states, metrics
 
     if distributed:
-        from jax import shard_map
+        from sheeprl_tpu.parallel.compat import shard_map
 
         def sharded(params, opt_states, data, keys):
             return shard_map(
@@ -193,10 +193,7 @@ def main(runtime, cfg):
         aggregator.disabled = True
     timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
 
-    envs = vectorized_env(
-        [make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i) for i in range(num_envs)],
-        sync=cfg.env.sync_env,
-    )
+    envs = pipelined_vector_env(cfg, make_env_fns(cfg, log_dir, "train"))
     observation_space = envs.single_observation_space
     action_space = envs.single_action_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -269,6 +266,44 @@ def main(runtime, cfg):
     batch_size = cfg.algo.per_rank_batch_size
     obs, _ = envs.reset(seed=cfg.seed)
 
+    def run_train(iter_num: int, per_rank_gradient_steps: int) -> None:
+        """Sample + dispatch this iteration's gradient steps and fetch the
+        metrics (the blocking fetch included, so the whole thing can ride
+        inside the env-step overlap window)."""
+        nonlocal rng_key, params, opt_states
+        with timer("Time/train_time"):
+            with diag.span("buffer-sample"):
+                sample = rb.sample(
+                    batch_size=local_sample_size(batch_size * world_size),
+                    n_samples=per_rank_gradient_steps,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )  # [G, B*world, ...]
+                data = {
+                    k: jnp.asarray(np.asarray(v), jnp.float32)
+                    for k, v in sample.items()
+                    if k in ("observations", "next_observations", "actions", "rewards", "terminated")
+                }
+            data = diag.maybe_inject_nan(iter_num, data)
+            with diag.span("train"):
+                rng_key, scan_key = jax.random.split(rng_key)
+                keys = jax.random.split(scan_key, per_rank_gradient_steps)
+                params, opt_states, losses = train_step(params, opt_states, data, keys)
+                losses = np.asarray(losses)
+        aggregator.update("Loss/value_loss", float(losses[0]))
+        aggregator.update("Loss/policy_loss", float(losses[1]))
+        aggregator.update("Loss/alpha_loss", float(losses[2]))
+        aggregator.update("Grads/global_norm", float(losses[3]))
+        diag.on_update(
+            policy_step_count,
+            {
+                "Loss/value_loss": float(losses[0]),
+                "Loss/policy_loss": float(losses[1]),
+                "Loss/alpha_loss": float(losses[2]),
+                "Grads/global_norm": float(losses[3]),
+            },
+            nonfinite=float(losses[4]),
+        )
+
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
         with timer("Time/env_interaction_time"), diag.span("rollout"):
@@ -278,10 +313,29 @@ def main(runtime, cfg):
                 rng_key, step_key = jax.random.split(rng_key)
                 flat_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
                 actions = np.asarray(policy_step(params["actor"], flat_obs, step_key))
-            next_obs, rewards, terminated, truncated, info = envs.step(
-                actions.reshape(envs.action_space.shape)
-            )
-            rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, -1)
+            with diag.span("env_step_async"):
+                envs.step_async(actions.reshape(envs.action_space.shape))
+
+        # --- two-stage pipeline: gradient steps overlap the env workers ----
+        # The sample sees transitions through t-1 (t's transition needs the
+        # next obs, which is still being computed) — a bounded one-transition
+        # lag (howto/async_envs.md) in exchange for a critical path of
+        # max(train_dispatch + metric fetch, env_step) instead of their sum.
+        # A still-empty buffer (learning_starts=0 first iteration) falls back
+        # to training after the add, i.e. the serialized order.
+        per_rank_gradient_steps = 0
+        trained = False
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step_count - prefill_steps * policy_steps_per_iter)
+            if cfg.dry_run:
+                per_rank_gradient_steps = 1
+            if per_rank_gradient_steps > 0 and not rb.empty:
+                run_train(iter_num, per_rank_gradient_steps)
+                trained = True
+
+        with timer("Time/env_interaction_time"), diag.span("env_wait"):
+            next_obs, rewards, terminated, truncated, info = envs.step_wait()
+        rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, -1)
 
         if "final_info" in info and "episode" in info["final_info"]:
             ep = info["final_info"]["episode"]
@@ -314,44 +368,10 @@ def main(runtime, cfg):
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
         obs = next_obs
 
-        # --- train (reference sac.py:299-355) ------------------------------
-        if iter_num >= learning_starts:
-            per_rank_gradient_steps = ratio((policy_step_count - prefill_steps * policy_steps_per_iter))
-            if cfg.dry_run:
-                per_rank_gradient_steps = 1
-            if per_rank_gradient_steps > 0:
-                with timer("Time/train_time"):
-                    with diag.span("buffer-sample"):
-                        sample = rb.sample(
-                            batch_size=local_sample_size(batch_size * world_size),
-                            n_samples=per_rank_gradient_steps,
-                            sample_next_obs=cfg.buffer.sample_next_obs,
-                        )  # [G, B*world, ...]
-                        data = {
-                            k: jnp.asarray(np.asarray(v), jnp.float32)
-                            for k, v in sample.items()
-                            if k in ("observations", "next_observations", "actions", "rewards", "terminated")
-                        }
-                    data = diag.maybe_inject_nan(iter_num, data)
-                    with diag.span("train"):
-                        rng_key, scan_key = jax.random.split(rng_key)
-                        keys = jax.random.split(scan_key, per_rank_gradient_steps)
-                        params, opt_states, losses = train_step(params, opt_states, data, keys)
-                        losses = np.asarray(losses)
-                aggregator.update("Loss/value_loss", float(losses[0]))
-                aggregator.update("Loss/policy_loss", float(losses[1]))
-                aggregator.update("Loss/alpha_loss", float(losses[2]))
-                aggregator.update("Grads/global_norm", float(losses[3]))
-                diag.on_update(
-                    policy_step_count,
-                    {
-                        "Loss/value_loss": float(losses[0]),
-                        "Loss/policy_loss": float(losses[1]),
-                        "Loss/alpha_loss": float(losses[2]),
-                        "Grads/global_norm": float(losses[3]),
-                    },
-                    nonfinite=float(losses[4]),
-                )
+        # --- train fallback (reference sac.py:299-355): only taken when the
+        # pipelined site above skipped because the buffer was still empty ----
+        if per_rank_gradient_steps > 0 and not trained:
+            run_train(iter_num, per_rank_gradient_steps)
 
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
             metrics = aggregator.compute()

@@ -26,7 +26,7 @@ from sheeprl_tpu.algos.sac.sac import make_train_step
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
@@ -66,10 +66,7 @@ def main(runtime, cfg):
         aggregator.disabled = True
     timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
 
-    envs = vectorized_env(
-        [make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i) for i in range(num_envs)],
-        sync=cfg.env.sync_env,
-    )
+    envs = pipelined_vector_env(cfg, make_env_fns(cfg, log_dir, "train"))
     observation_space = envs.single_observation_space
     action_space = envs.single_action_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -147,6 +144,47 @@ def main(runtime, cfg):
     batch_size = cfg.algo.per_rank_batch_size
     obs, _ = envs.reset(seed=cfg.seed)
 
+    def run_train(iter_num: int, per_rank_gradient_steps: int) -> None:
+        """Sample + dispatch this iteration's gradient steps on the trainer
+        sub-mesh and fetch the metrics (the blocking fetch included, so the
+        whole thing rides inside the env-step overlap window)."""
+        nonlocal rng_key, params, opt_states, player_actor_params
+        with timer("Time/train_time"):
+            # player samples; batches "scattered" onto the trainer mesh
+            with diag.span("buffer-sample"):
+                sample = rb.sample(
+                    batch_size=batch_size * n_trainers,
+                    n_samples=per_rank_gradient_steps,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )
+                data = {
+                    k: jax.device_put(jnp.asarray(np.asarray(v), jnp.float32), trainer_data_sharding)
+                    for k, v in sample.items()
+                    if k in ("observations", "next_observations", "actions", "rewards", "terminated")
+                }
+            data = diag.maybe_inject_nan(iter_num, data)
+            with diag.span("train"):
+                rng_key, scan_key = jax.random.split(rng_key)
+                keys = jax.random.split(scan_key, per_rank_gradient_steps)
+                params, opt_states, losses = train_step(params, opt_states, data, keys)
+                losses = np.asarray(losses)
+        # actor params broadcast back to the player (reference :550-554)
+        player_actor_params = jax.device_put(params["actor"], player_device)
+        aggregator.update("Loss/value_loss", float(losses[0]))
+        aggregator.update("Loss/policy_loss", float(losses[1]))
+        aggregator.update("Loss/alpha_loss", float(losses[2]))
+        aggregator.update("Grads/global_norm", float(losses[3]))
+        diag.on_update(
+            policy_step_count,
+            {
+                "Loss/value_loss": float(losses[0]),
+                "Loss/policy_loss": float(losses[1]),
+                "Loss/alpha_loss": float(losses[2]),
+                "Grads/global_norm": float(losses[3]),
+            },
+            nonfinite=float(losses[4]),
+        )
+
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
         with timer("Time/env_interaction_time"), diag.span("rollout"):
@@ -156,10 +194,25 @@ def main(runtime, cfg):
                 rng_key, step_key = jax.random.split(rng_key)
                 flat_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
                 actions = np.asarray(policy_step(player_actor_params, flat_obs, step_key))
-            next_obs, rewards, terminated, truncated, info = envs.step(
-                actions.reshape(envs.action_space.shape)
-            )
-            rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, -1)
+            with diag.span("env_step_async"):
+                envs.step_async(actions.reshape(envs.action_space.shape))
+
+        # --- two-stage pipeline: trainer-mesh gradient steps overlap the env
+        # workers (same bounded one-transition sample lag as sac.py; empty
+        # buffer falls back to the serialized order below) -------------------
+        per_rank_gradient_steps = 0
+        trained = False
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step_count - prefill_steps * policy_steps_per_iter)
+            if cfg.dry_run:
+                per_rank_gradient_steps = 1
+            if per_rank_gradient_steps > 0 and not rb.empty:
+                run_train(iter_num, per_rank_gradient_steps)
+                trained = True
+
+        with timer("Time/env_interaction_time"), diag.span("env_wait"):
+            next_obs, rewards, terminated, truncated, info = envs.step_wait()
+        rewards = np.asarray(rewards, dtype=np.float32).reshape(num_envs, -1)
 
         if "final_info" in info and "episode" in info["final_info"]:
             ep = info["final_info"]["episode"]
@@ -191,46 +244,9 @@ def main(runtime, cfg):
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
         obs = next_obs
 
-        if iter_num >= learning_starts:
-            per_rank_gradient_steps = ratio(policy_step_count - prefill_steps * policy_steps_per_iter)
-            if cfg.dry_run:
-                per_rank_gradient_steps = 1
-            if per_rank_gradient_steps > 0:
-                with timer("Time/train_time"):
-                    # player samples; batches "scattered" onto the trainer mesh
-                    with diag.span("buffer-sample"):
-                        sample = rb.sample(
-                            batch_size=batch_size * n_trainers,
-                            n_samples=per_rank_gradient_steps,
-                            sample_next_obs=cfg.buffer.sample_next_obs,
-                        )
-                        data = {
-                            k: jax.device_put(jnp.asarray(np.asarray(v), jnp.float32), trainer_data_sharding)
-                            for k, v in sample.items()
-                            if k in ("observations", "next_observations", "actions", "rewards", "terminated")
-                        }
-                    data = diag.maybe_inject_nan(iter_num, data)
-                    with diag.span("train"):
-                        rng_key, scan_key = jax.random.split(rng_key)
-                        keys = jax.random.split(scan_key, per_rank_gradient_steps)
-                        params, opt_states, losses = train_step(params, opt_states, data, keys)
-                        losses = np.asarray(losses)
-                # actor params broadcast back to the player (reference :550-554)
-                player_actor_params = jax.device_put(params["actor"], player_device)
-                aggregator.update("Loss/value_loss", float(losses[0]))
-                aggregator.update("Loss/policy_loss", float(losses[1]))
-                aggregator.update("Loss/alpha_loss", float(losses[2]))
-                aggregator.update("Grads/global_norm", float(losses[3]))
-                diag.on_update(
-                    policy_step_count,
-                    {
-                        "Loss/value_loss": float(losses[0]),
-                        "Loss/policy_loss": float(losses[1]),
-                        "Loss/alpha_loss": float(losses[2]),
-                        "Grads/global_norm": float(losses[3]),
-                    },
-                    nonfinite=float(losses[4]),
-                )
+        # --- train fallback: pipelined site skipped on an empty buffer -----
+        if per_rank_gradient_steps > 0 and not trained:
+            run_train(iter_num, per_rank_gradient_steps)
 
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
             metrics = aggregator.compute()

@@ -32,7 +32,7 @@ from sheeprl_tpu.algos.sac_ae.utils import (  # noqa: F401
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.envs.env import make_env, vectorized_env
+from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage, local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -242,10 +242,7 @@ def main(runtime, cfg):
         aggregator.disabled = True
     timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
 
-    envs = vectorized_env(
-        [make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i) for i in range(num_envs)],
-        sync=cfg.env.sync_env,
-    )
+    envs = pipelined_vector_env(cfg, make_env_fns(cfg, log_dir, "train"))
     observation_space = envs.single_observation_space
     action_space = envs.single_action_space
     if not isinstance(observation_space, gym.spaces.Dict):

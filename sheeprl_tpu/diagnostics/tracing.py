@@ -24,6 +24,21 @@ from typing import Any, Dict, Optional
 
 TRACE_NAME = "trace.json"
 
+# Span names the training loops emit (free-form names are fine too; these are
+# the vocabulary howto/diagnostics.md documents).  ``env_step_async`` times
+# issuing the split-phase env dispatch and ``env_wait`` the blocking collect —
+# in Perfetto the gap between an ``env_step_async`` span and its iteration's
+# ``env_wait`` span is exactly the env time hidden behind device dispatch, so
+# the async env pipeline's overlap (howto/async_envs.md) is directly visible.
+KNOWN_PHASES = (
+    "rollout",
+    "env_step_async",
+    "env_wait",
+    "buffer-sample",
+    "train",
+    "checkpoint",
+)
+
 
 class PhaseTracer:
     """Streaming Trace-Event writer with a ``span`` context manager."""

@@ -1,10 +1,16 @@
 """Deterministic synthetic environments used as the test backbone
 (reference /root/reference/sheeprl/envs/dummy.py).  They produce a dict
 observation space with a ``rgb`` pixel key (CHW uint8) and a ``state`` vector
-key, across the three action-space families."""
+key, across the three action-space families.
+
+``sleep_ms`` gives ``step`` a deterministic wall-clock latency (a plain
+``time.sleep``, so it overlaps host work from a worker thread/process exactly
+like a real simulator would) — the async env-pipeline tests use it to assert
+wall-clock overlap without depending on a real slow environment."""
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Tuple
 
 import gymnasium as gym
@@ -18,8 +24,10 @@ class _DummyEnv(gym.Env):
         n_steps: int = 128,
         vector_shape: Tuple[int, ...] = (10,),
         dict_obs_space: bool = True,
+        sleep_ms: float = 0.0,
     ):
         self._dict_obs_space = dict_obs_space
+        self._sleep_s = max(0.0, float(sleep_ms)) / 1000.0
         if dict_obs_space:
             self.observation_space = gym.spaces.Dict(
                 {
@@ -42,6 +50,8 @@ class _DummyEnv(gym.Env):
         return np.full(self.observation_space.shape, self._current_step % 20, dtype=np.float32)
 
     def step(self, action):
+        if self._sleep_s > 0.0:
+            time.sleep(self._sleep_s)
         done = self._current_step == self._n_steps
         self._current_step += 1
         return self.get_obs(), 0.0, done, False, {}

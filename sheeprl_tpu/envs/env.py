@@ -221,6 +221,60 @@ def make_env(
     return thunk
 
 
+def make_env_fns(cfg, log_dir: Optional[str] = None, prefix: str = "train", restartable: bool = True):
+    """Every training loop's env thunks, built in one place.
+
+    Each thunk is wrapped in :class:`~sheeprl_tpu.envs.wrappers.RestartOnException`
+    (the Dreamer loops always did this; the on-policy loops used to pass bare
+    ``make_env`` fns, so one transient env crash killed the whole run).  A
+    restarted env surfaces ``info["restart_on_exception"]`` — loops that track
+    episode continuity (Dreamer) patch their buffers from it, everyone else
+    just keeps training through the discontinuity.  Construction-time errors
+    (bad config, missing sim) still raise immediately.
+    """
+    from functools import partial
+
+    from sheeprl_tpu.envs.wrappers import RestartOnException
+
+    fns = []
+    for i in range(cfg.env.num_envs):
+        thunk = make_env(cfg, cfg.seed + i, 0, log_dir, prefix, vector_env_idx=i)
+        fns.append(partial(RestartOnException, thunk) if restartable else thunk)
+    return fns
+
+
+def resolve_executor(cfg) -> str:
+    """Map ``cfg.env.executor`` (new knob) + ``cfg.env.sync_env`` (legacy) to
+    an executor name: ``sync`` | ``async`` | ``shared_memory``.  Unset/``auto``
+    honors ``sync_env`` verbatim, so existing configs behave identically."""
+    executor = cfg.env.get("executor", None)
+    if executor in (None, "", "auto"):
+        return "sync" if cfg.env.sync_env else "async"
+    executor = str(executor)
+    from sheeprl_tpu.envs.pipeline import EXECUTORS
+
+    if executor not in EXECUTORS:
+        raise ValueError(f"env.executor must be one of {EXECUTORS} (or null/auto), got: {executor}")
+    return executor
+
+
+def pipelined_vector_env(cfg, env_fns):
+    """Build the configured executor and wrap it in
+    :class:`~sheeprl_tpu.envs.pipeline.PipelinedVectorEnv` so the hot loops
+    can ``step_async``/``step_wait``.  ``step()`` still works for loops that
+    have not been rewired."""
+    from sheeprl_tpu.envs.pipeline import PipelinedVectorEnv
+
+    executor = resolve_executor(cfg)
+    if executor == "shared_memory":
+        from sheeprl_tpu.envs.executor import SharedMemoryVectorEnv
+
+        envs = SharedMemoryVectorEnv(env_fns, context="spawn")
+    else:
+        envs = vectorized_env(env_fns, sync=executor == "sync")
+    return PipelinedVectorEnv(envs)
+
+
 def vectorized_env(env_fns, sync: bool = True) -> gym.vector.VectorEnv:
     """SyncVectorEnv or AsyncVectorEnv (one OS subprocess per env — the
     reference's actor parallelism, utils/env.py + e.g. algos/ppo/ppo.py:137).
@@ -246,18 +300,21 @@ def vectorized_env(env_fns, sync: bool = True) -> gym.vector.VectorEnv:
     return gym.vector.AsyncVectorEnv(env_fns, autoreset_mode=mode, context="spawn")
 
 
-def get_dummy_env(id: str) -> gym.Env:
-    """Dummy env selector (reference utils/env.py:240-249)."""
+def get_dummy_env(id: str, sleep_ms: float = 0.0) -> gym.Env:
+    """Dummy env selector (reference utils/env.py:240-249).  ``sleep_ms``
+    (settable as ``env.wrapper.sleep_ms``) gives each step a deterministic
+    wall-clock latency so pipelining overlap is testable without a real
+    slow simulator."""
     if "continuous" in id:
         from sheeprl_tpu.envs.dummy import ContinuousDummyEnv
 
-        return ContinuousDummyEnv()
+        return ContinuousDummyEnv(sleep_ms=sleep_ms)
     elif "multidiscrete" in id:
         from sheeprl_tpu.envs.dummy import MultiDiscreteDummyEnv
 
-        return MultiDiscreteDummyEnv()
+        return MultiDiscreteDummyEnv(sleep_ms=sleep_ms)
     elif "discrete" in id:
         from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
 
-        return DiscreteDummyEnv()
+        return DiscreteDummyEnv(sleep_ms=sleep_ms)
     raise ValueError(f"Unrecognized dummy environment: {id}")

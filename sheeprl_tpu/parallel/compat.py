@@ -1,0 +1,33 @@
+"""Version-compat imports for jax APIs that moved between releases.
+
+``shard_map`` was promoted out of ``jax.experimental`` (and its replication
+check renamed ``check_rep`` -> ``check_vma``) in newer jax; this image ships a
+jax where only the experimental spelling exists.  Import the one canonical
+wrapper from here instead of ``from jax import shard_map`` so every call site
+works on both sides of the move — the bare top-level import was the single
+cause of all 2-device test failures on this image.
+"""
+
+from __future__ import annotations
+
+try:  # newer jax: top-level export, `check_vma` kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KWARG = "check_vma"
+except ImportError:  # this image's jax: experimental module, `check_rep` kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KWARG = "check_rep"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the replication-check kwarg translated to
+    whatever the installed jax calls it (``check_vma`` is the modern name,
+    ``check_rep`` the experimental-era one — same semantics)."""
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KWARG: check_vma},
+    )

@@ -80,7 +80,7 @@ def dp_jit(
     """
     if dp_axis(mesh) is None:
         return jax.jit(fn, donate_argnums=donate_argnums)
-    from jax import shard_map
+    from sheeprl_tpu.parallel.compat import shard_map
 
     mapped = shard_map(fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs, check_vma=False)
     return jax.jit(mapped, donate_argnums=donate_argnums)

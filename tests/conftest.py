@@ -23,9 +23,83 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Multi-device runs these tests exercise were unblocked wholesale by the
+# parallel/compat.py shard_map shim (they used to ImportError in seconds;
+# now each compiles a real sharded graph for tens of seconds).  The tier-1
+# smoke (-m 'not slow', hard wall-clock budget) keeps one representative per
+# algo family / sharding surface — test_ppo[2-discrete], test_a2c[2-discrete],
+# test_sac[2], test_ppo_recurrent[2-discrete], the decoupled tests, the DV3
+# sharded-step + quantile HLO checks, and the sharded-buffer unit trio — and
+# defers these redundant siblings.  tests/run_tests.py's CI suites run
+# without the marker filter, so they stay fully covered there.
+_TIER1_DEFERRED_TO_CI = {
+    "tests/test_algos/test_algos.py::test_ppo[2-multidiscrete_dummy]",
+    "tests/test_algos/test_algos.py::test_ppo[2-continuous_dummy]",
+    "tests/test_algos/test_algos.py::test_ppo_resume[2]",
+    "tests/test_algos/test_algos.py::test_a2c[2-multidiscrete_dummy]",
+    "tests/test_algos/test_algos.py::test_a2c[2-continuous_dummy]",
+    "tests/test_algos/test_algos.py::test_sac_sample_next_obs[2]",
+    "tests/test_algos/test_algos.py::test_ppo_recurrent[2-continuous_dummy]",
+    "tests/test_data/test_device_buffer.py::test_dreamer_v3_e2e_with_sharded_device_buffer",
+    "tests/test_parallel/test_dp_sharding.py::test_offpolicy_step_is_sharded_with_collectives[droq]",
+    "tests/test_parallel/test_dp_sharding.py::test_offpolicy_step_is_sharded_with_collectives[sac_ae]",
+    # The four longest single tests of the suite (40-65 s each, measured with
+    # --durations): fitting the newly-unblocked 2-device proofs inside the
+    # tier-1 wall-clock budget means deferring these to the CI suites.  Their
+    # tier-1 surfaces stay covered by cheaper siblings — bf16 correctness by
+    # test_dreamer_v3_bf16_e2e / test_ppo_bf16_e2e, P2E by the exploration
+    # tests, journal crash-safety by the truncation-recovery unit tests.
+    "tests/test_parallel/test_precision.py::test_dv3_bf16_mixed_loss_parity_and_dtypes",
+    "tests/test_parallel/test_precision.py::test_dv3_bf16_true_param_dtype",
+    "tests/test_algos/test_algos.py::test_p2e_dv3_finetuning_from_exploration_checkpoint[1]",
+    "tests/test_diagnostics/test_cli_e2e.py::test_sigkilled_run_leaves_recoverable_journal",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    nodeids = set()
+    for item in items:
+        nodeids.add(item.nodeid)
+        if item.nodeid in _TIER1_DEFERRED_TO_CI:
+            item.add_marker(pytest.mark.slow)
+    # A renamed/re-parametrized test would silently fall out of the deferral
+    # list and back into the tier-1 wall-clock budget; flag stale entries
+    # whenever their file was collected (a warning, not an assert, so
+    # single-test invocations of a listed file still work).
+    collected_files = {n.split("::", 1)[0] for n in nodeids}
+    stale = {
+        n for n in _TIER1_DEFERRED_TO_CI if n.split("::", 1)[0] in collected_files and n not in nodeids
+    }
+    if stale and len(items) > len(_TIER1_DEFERRED_TO_CI):
+        import warnings
+
+        warnings.warn(
+            f"_TIER1_DEFERRED_TO_CI entries matched no collected test (renamed?): {sorted(stale)}",
+            stacklevel=1,
+        )
+
 
 @pytest.fixture(autouse=True)
 def _tmp_logs(tmp_path, monkeypatch):
     """Keep run artifacts (logs/, checkpoints) inside pytest tmp dirs."""
     monkeypatch.chdir(tmp_path)
     yield
+
+
+@pytest.fixture
+def run_cli():
+    """Drive the real CLI the way `python sheeprl.py ...` does.  New tests
+    should use this instead of re-rolling the argv mock (two pre-existing
+    module-local `_run_cli` helpers in test_algos/test_precision remain to be
+    migrated)."""
+    import sys
+    from unittest import mock
+
+    def _run(*args: str) -> None:
+        from sheeprl_tpu.cli import run
+
+        argv = ["sheeprl_tpu", *args]
+        with mock.patch.object(sys, "argv", argv):
+            run(argv[1:])
+
+    return _run

@@ -7,8 +7,10 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
 
 * **unit** — everything except the e2e algorithm suite and the multihost
   test: ops goldens vs reference numerics, buffers (host/memmap/HBM),
-  models, env layer, config/CLI utils, sharding-HLO checks.  ~8 min on one
-  CPU core.  Budget: 25 min.
+  models, env layer (incl. `tests/test_envs/test_async_pipeline.py`: the
+  split-phase executor goldens, shm-worker crash recovery, overlap timing,
+  and the `executor=shared_memory` CLI smokes), config/CLI utils,
+  sharding-HLO checks.  ~8 min on one CPU core.  Budget: 25 min.
 * **e2e** — `tests/test_algos/` drives every algorithm through the real CLI
   on dummy envs at 1 and 2 virtual devices.  Slow by nature (each test
   compiles a train step).  Budget: 40 min.

@@ -14,6 +14,15 @@ import sys
 
 import pytest
 
+# tier-1 (-m 'not slow') skips this module: the image's jax cannot run
+# multiprocess collectives on the CPU backend ("Multiprocess computations
+# aren't implemented on the CPU backend" out of multihost_utils.broadcast),
+# so both tests fail environmentally after burning minutes of rendezvous —
+# a pre-existing, documented cause (CHANGES.md PR 2).  The dedicated CI
+# multihost suite (tests/run_tests.py, 40 min budget, no marker filter)
+# still runs them for environments whose jax supports the DCN path.
+pytestmark = pytest.mark.slow
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
 
 

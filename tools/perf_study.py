@@ -41,6 +41,53 @@ def measure_tunnel():
     }
 
 
+def measure_env_host(sleep_ms: float = 50.0, iters: int = 20, host_work_ms: float = 30.0):
+    """Host-time split of the env pipeline: what ``envs.step`` used to cost on
+    the hot thread vs what the split-phase layer leaves on it
+    (``step_async`` issuance + the residual ``env_wait`` after ``host_work_ms``
+    of overlapped work).  Pure host measurement on ``sleep_ms`` dummies — no
+    accelerator needed, so this section runs even on a dead tunnel.
+    ``hidden_ms`` is the per-iteration env time the pipeline takes off the
+    critical path (≈ min(sleep_ms, host_work_ms))."""
+    import numpy as np
+
+    from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+    from sheeprl_tpu.envs.env import vectorized_env
+    from sheeprl_tpu.envs.pipeline import PipelinedVectorEnv
+
+    def mk():
+        return DiscreteDummyEnv(n_steps=1_000_000, image_size=(3, 8, 8), sleep_ms=sleep_ms)
+
+    envs = PipelinedVectorEnv(vectorized_env([mk], sync=True))
+    envs.reset(seed=0)
+    actions = np.zeros(1, np.int64)
+    step_s = async_s = wait_s = 0.0
+    for _ in range(iters):  # serialized: the whole env latency is host time
+        t0 = time.perf_counter()
+        envs.step(actions)
+        step_s += time.perf_counter() - t0
+    for _ in range(iters):  # pipelined: issue, overlap host work, collect
+        t0 = time.perf_counter()
+        envs.step_async(actions)
+        async_s += time.perf_counter() - t0
+        time.sleep(host_work_ms / 1e3)  # stand-in for train dispatch + fetch
+        t0 = time.perf_counter()
+        envs.step_wait()
+        wait_s += time.perf_counter() - t0
+    envs.close()
+    env_step_ms = step_s / iters * 1e3
+    env_wait_ms = wait_s / iters * 1e3
+    return {
+        "experiment": "env_overlap_host",
+        "sleep_ms": sleep_ms,
+        "host_work_ms": host_work_ms,
+        "env_step_ms": round(env_step_ms, 2),
+        "env_step_async_ms": round(async_s / iters * 1e3, 3),
+        "env_wait_ms": round(env_wait_ms, 2),
+        "hidden_ms": round(env_step_ms - env_wait_ms, 2),
+    }
+
+
 PHASE_EXPERIMENTS = {
     # Phase isolation by config deltas vs the base (T=64, H=15, pixel obs):
     # the difference between base and each variant prices one phase.
@@ -160,6 +207,10 @@ def main() -> None:
     batches = [int(b) for b in os.environ.get("PERF_BATCHES", "16,32,64").split(",")]
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
     phases = os.environ.get("PERF_PHASES", "0") == "1"
+
+    # env pipeline host-time split first: needs no accelerator, so it lands
+    # even when the probe below aborts the chip sections
+    print(json.dumps(measure_env_host()), flush=True)
 
     # fail FAST on a dead tunnel instead of wedging inside the first blocking
     # fetch: this is the chip-study tool — unlike bench.py there is no useful
