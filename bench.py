@@ -220,6 +220,11 @@ def measure_compute(
         "flops_per_step": flops,
         "tflops_per_sec": round(tflops, 2) if tflops else None,
         "mfu": round(mfu, 4) if mfu else None,
+        # same field names as the live telemetry layer journals
+        # (sheeprl_tpu/diagnostics/telemetry.py), so offline bench numbers
+        # and a live run's journal rows diff directly (ISSUE 3)
+        "Telemetry/tflops_per_sec": round(tflops, 4) if tflops else None,
+        "Telemetry/mfu": round(mfu, 4) if mfu else None,
         "device_kind": device_kind,
     }
     if peak_assumed:
@@ -323,6 +328,14 @@ def measure_e2e(
 
     from sheeprl_tpu.parallel.dp import normalize_staged
 
+    # the SAME phase accounting the live telemetry layer runs (nesting-aware
+    # self-time per span), so the bench's phase breakdown and a live run's
+    # Telemetry/phase_pct/* rows are directly comparable
+    from sheeprl_tpu.diagnostics.telemetry import Telemetry
+
+    tele = Telemetry({})
+    tele.open()
+
     def one_iter(params, opt_states, moments_state, step_data, obs, key, pipelined):
         """One policy step + one gradient step (ratio 1).
 
@@ -336,8 +349,9 @@ def measure_e2e(
         action -> env.step -> train) for an apples-to-apples overlap number.
         """
         key, k_step, k_train = jax.random.split(key, 3)
-        torch_obs = prepare_obs(obs, cnn_keys=cnn_obs_keys, mlp_keys=mlp_obs_keys, num_envs=num_envs)
-        actions_jnp = player.get_actions(params["world_model"], params["actor"], torch_obs, k_step)
+        with tele.span("rollout"):
+            torch_obs = prepare_obs(obs, cnn_keys=cnn_obs_keys, mlp_keys=mlp_obs_keys, num_envs=num_envs)
+            actions_jnp = player.get_actions(params["world_model"], params["actor"], torch_obs, k_step)
 
         def fetch_and_step_envs(step_data, obs):
             actions = np.asarray(actions_jnp)
@@ -352,27 +366,32 @@ def measure_e2e(
             return step_data, obs
 
         if pipelined:
-            step_data["actions"] = jnp.reshape(actions_jnp, (1, num_envs, -1))
-            rb.add(step_data)
-            # device->host copy overlaps the train dispatch below
-            actions_jnp.copy_to_host_async()
+            with tele.span("rollout"):
+                step_data["actions"] = jnp.reshape(actions_jnp, (1, num_envs, -1))
+                rb.add(step_data)
+                # device->host copy overlaps the train dispatch below
+                actions_jnp.copy_to_host_async()
         else:
-            actions = np.asarray(actions_jnp)
-            step_data["actions"] = actions.reshape(1, num_envs, -1)
-            rb.add(step_data)
-            step_data, obs = fetch_and_step_envs(step_data, obs)
+            with tele.span("rollout"):
+                actions = np.asarray(actions_jnp)
+                step_data["actions"] = actions.reshape(1, num_envs, -1)
+                rb.add(step_data)
+            with tele.span("env_wait"):
+                step_data, obs = fetch_and_step_envs(step_data, obs)
 
         # in-HBM sequence gather + ratio-1 gradient steps (one per policy
         # step, so num_envs of them per iteration)
-        for staged in rb.sample(B, sequence_length=T, n_samples=num_envs):
-            batch = normalize_staged(staged, obs_keys)
-            k_train, sub = jax.random.split(k_train)
-            params, opt_states, moments_state, metrics = train_step(
-                params, opt_states, moments_state, batch, sub, jnp.float32(0.02)
-            )
+        with tele.span("train"):
+            for staged in rb.sample(B, sequence_length=T, n_samples=num_envs):
+                batch = normalize_staged(staged, obs_keys)
+                k_train, sub = jax.random.split(k_train)
+                params, opt_states, moments_state, metrics = train_step(
+                    params, opt_states, moments_state, batch, sub, jnp.float32(0.02)
+                )
 
         if pipelined:
-            step_data, obs = fetch_and_step_envs(step_data, obs)
+            with tele.span("env_wait"):
+                step_data, obs = fetch_and_step_envs(step_data, obs)
         return params, opt_states, moments_state, step_data, obs, key, metrics
 
     results = {}
@@ -383,6 +402,7 @@ def measure_e2e(
             )
         _ = np.asarray(metrics)  # value barrier (see measure_compute note)
 
+        tele.interval_metrics(None)  # drop warmup from the phase accounting
         t0 = time.perf_counter()
         for _ in range(measure_iters):
             params, opt_states, moments_state, step_data, obs, key, metrics = one_iter(
@@ -391,6 +411,12 @@ def measure_e2e(
         _ = np.asarray(metrics)
         elapsed = time.perf_counter() - t0
         results[f"grad_steps_per_sec_e2e_{mode}"] = round(measure_iters * num_envs / elapsed, 3)
+        if pipelined:  # phase breakdown of the shipped (pipelined) hot loop
+            phases = tele.interval_metrics(None)
+            results.update(
+                {k: round(v, 2) for k, v in phases.items() if k.startswith("Telemetry/phase_pct/")}
+            )
+    tele.close()  # detach from the process-global compile-listener registry
     envs.close()
     return {
         "grad_steps_per_sec_e2e": results["grad_steps_per_sec_e2e_pipelined"],
@@ -521,17 +547,30 @@ def _ensure_responsive_device():
     # Popen + poll instead of subprocess.run: a probe child hung on a dead
     # tunnel can be in UNKILLABLE D-state (stuck in the device driver), and
     # run()'s TimeoutExpired cleanup then blocks forever in process.wait() —
-    # the probe itself would hang the bench it exists to protect.
+    # the probe itself would hang the bench it exists to protect.  The probe
+    # prints the resolved PLATFORM, not just liveness: a responsive backend
+    # that turns out to be the CPU (site plugin silently falling back, or a
+    # forced-cpu environment) must take the CPU-fallback workload too — the
+    # flagship pixel menu on one CPU core burns the whole budget for nothing
+    # (exactly what a responsive-but-CPU probe let happen before r6).
+    import tempfile
+
+    probe_out = tempfile.NamedTemporaryFile(mode="w+", suffix=".txt", delete=False)
     proc = subprocess.Popen(
-        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-        stdout=subprocess.DEVNULL,
+        [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+        stdout=probe_out,
         stderr=subprocess.DEVNULL,
     )
     try:
         rc = proc.wait(timeout=180)
         if rc == 0:
-            return None
-        reason = f"device enumeration failed (exit {rc})"
+            probe_out.seek(0)
+            platform = probe_out.read().strip().lower()
+            if platform and platform != "cpu":
+                return None
+            reason = f"no accelerator behind the responsive backend (platform={platform or '?'})"
+        else:
+            reason = f"device enumeration failed (exit {rc})"
     except subprocess.TimeoutExpired:
         proc.kill()
         try:
@@ -539,6 +578,12 @@ def _ensure_responsive_device():
         except subprocess.TimeoutExpired:
             pass  # D-state child: abandon it rather than wait forever
         reason = "accelerator link unresponsive (enumeration timed out)"
+    finally:
+        probe_out.close()
+        try:
+            os.unlink(probe_out.name)
+        except OSError:
+            pass
     print(f"WARNING: {reason}; benching on CPU", file=sys.stderr)
     import jax
 
@@ -580,6 +625,27 @@ def _run_cpu_fallback(record: dict, precision: str) -> None:
     )
     record["value"] = e2e["grad_steps_per_sec_e2e"]
     record.update({k: v for k, v in e2e.items() if k != "grad_steps_per_sec_e2e"})
+    # tiny compute stage so the Telemetry/* alias fields (mfu, tflops/s —
+    # same names the live layer journals) land even on the fallback path;
+    # the MFU is against the assumed v5e peak and explicitly marked as such
+    try:
+        compute = measure_compute(
+            precision,
+            size="XS",
+            batch_size=4,
+            measure_steps=10,
+            extra_overrides=[
+                "algo.per_rank_sequence_length=16",
+                "algo.cnn_keys.encoder=[]",
+                "algo.cnn_keys.decoder=[]",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.mlp_keys.decoder=[state]",
+            ],
+        )
+        record.update({k: v for k, v in compute.items() if k != "grad_steps_per_sec_compute"})
+        record["grad_steps_per_sec_compute_XS"] = compute["grad_steps_per_sec_compute"]
+    except Exception as err:  # noqa: BLE001 — the liveness number must land regardless
+        record.setdefault("stage_errors", {})["compute_XS"] = repr(err)
 
 
 def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:

@@ -164,12 +164,19 @@ def main(runtime, cfg):
     else:
         data_sharding = None
 
-    train_step = make_train_step(agent, optimizer, cfg, runtime.mesh)
+    # telemetry instrumentation: watchdog + MFU FLOPs on the train step,
+    # signature watch on the rollout policy (no shape-change injection here:
+    # A2C's update consumes the whole batch, padding would alter the gradient)
+    train_step = diag.instrument(
+        "train_step", make_train_step(agent, optimizer, cfg, runtime.mesh), kind="train"
+    )
 
     @jax.jit
     def policy_step(params, obs, key):
         actions, logprobs, _, values = agent.apply(params, obs, key=key)
         return actions, logprobs, values
+
+    policy_step = diag.instrument("policy_step", policy_step, kind="rollout")
 
     @jax.jit
     def value_step(params, obs):

@@ -525,15 +525,23 @@ def _dreamer_main(
         opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
         moments_state = jax.device_put(moments_state, replicated_sharding(runtime.mesh))
 
-    train_step = make_train_step_fn(
-        world_model_def,
-        actor_def,
-        critic_def,
-        optimizers,
-        cfg,
-        actions_dim,
-        is_continuous,
-        mesh=runtime.mesh if world_size > 1 else None,
+    # telemetry instrumentation (shared engine: dv3 / jepa / p2e inherit):
+    # recompile watchdog + exact compiled-step FLOPs for the live MFU gauge.
+    # The player forward stays uninstrumented — its compiles are still counted
+    # by the process-wide jax.monitoring listener.
+    train_step = diag.instrument(
+        "train_step",
+        make_train_step_fn(
+            world_model_def,
+            actor_def,
+            critic_def,
+            optimizers,
+            cfg,
+            actions_dim,
+            is_continuous,
+            mesh=runtime.mesh if world_size > 1 else None,
+        ),
+        kind="train",
     )
 
     buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 2

@@ -255,13 +255,22 @@ def main(runtime, cfg):
     else:
         data_sharding = None
 
-    train_step = make_train_step(agent, optimizer, cfg, runtime.mesh, num_minibatches, batch_size)
+    # telemetry instrumentation (recompile watchdog + cost_analysis FLOPs for
+    # MFU): the train step dispatches through the AOT-compiled executable,
+    # the rollout policy keeps native jit dispatch with signature watching
+    train_step = diag.instrument(
+        "train_step",
+        make_train_step(agent, optimizer, cfg, runtime.mesh, num_minibatches, batch_size),
+        kind="train",
+    )
 
     # jitted rollout policy + value bootstrap
     @jax.jit
     def policy_step(params, obs, key):
         actions, logprobs, _, values = agent.apply(params, obs, key=key)
         return actions, logprobs, values
+
+    policy_step = diag.instrument("policy_step", policy_step, kind="rollout")
 
     @jax.jit
     def value_step(params, obs):
@@ -395,6 +404,9 @@ def main(runtime, cfg):
                 flat,
             )
         device_data = diag.maybe_inject_nan(iter_num, device_data)
+        # recompile-watchdog drill: pads world_size rows that the minibatch
+        # indexing never reads (training math unchanged, graph recompiles)
+        device_data = diag.maybe_inject_shape_change(iter_num, device_data, pad=world_size)
 
         # ---- annealing (reference ppo.py:415-424) -------------------------
         if cfg.algo.anneal_clip_coef:

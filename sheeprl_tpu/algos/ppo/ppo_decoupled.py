@@ -136,12 +136,18 @@ def main(runtime, cfg):
     opt_state = jax.device_put(opt_state, trainer_repl)
     player_params = jax.device_put(params, player_device)
 
-    train_step = make_train_step(agent, optimizer, cfg, trainer_mesh, num_minibatches, batch_size)
+    train_step = diag.instrument(
+        "train_step",
+        make_train_step(agent, optimizer, cfg, trainer_mesh, num_minibatches, batch_size),
+        kind="train",
+    )
 
     @jax.jit
     def _policy_step(params, obs, key):
         actions, logprobs, _, values = agent.apply(params, obs, key=key)
         return actions, logprobs, values
+
+    _policy_step = diag.instrument("policy_step", _policy_step, kind="rollout")
 
     def policy_step(params, obs, key):
         obs = jax.device_put(obs, player_device)
@@ -178,7 +184,7 @@ def main(runtime, cfg):
 
     for iter_num in range(start_iter, total_iters + 1):
         # ---- PLAYER: rollout on device 0 (reference ppo_decoupled.py:169-299)
-        with timer("Time/env_interaction_time"), diag.span("rollout"):
+        with timer("Time/env_interaction_time"), diag.span("rollout", role="player"):
             for _ in range(rollout_steps):
                 policy_step_count += num_envs
                 rng_key, step_key = jax.random.split(rng_key)
@@ -254,6 +260,7 @@ def main(runtime, cfg):
             lambda x: jax.device_put(jnp.asarray(x), trainer_data_sharding), flat
         )
         device_data = diag.maybe_inject_nan(iter_num, device_data)
+        device_data = diag.maybe_inject_shape_change(iter_num, device_data, pad=n_trainers)
 
         if cfg.algo.anneal_clip_coef:
             clip_coef = polynomial_decay(
@@ -265,7 +272,7 @@ def main(runtime, cfg):
             )
 
         # ---- TRAINERS: update epochs on the sub-mesh ----------------------
-        with timer("Time/train_time"), diag.span("train"):
+        with timer("Time/train_time"), diag.span("train", role="trainer"):
             rng_key, train_key = jax.random.split(rng_key)
             coefs = (
                 jnp.asarray(clip_coef, jnp.float32),

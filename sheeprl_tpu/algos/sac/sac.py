@@ -230,12 +230,18 @@ def main(runtime, cfg):
         params = jax.device_put(params, replicated_sharding(runtime.mesh))
         opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
 
-    train_step = make_train_step(actor_def, critic_def, optimizers, cfg, runtime.mesh, target_entropy)
+    train_step = diag.instrument(
+        "train_step",
+        make_train_step(actor_def, critic_def, optimizers, cfg, runtime.mesh, target_entropy),
+        kind="train",
+    )
 
     @jax.jit
     def policy_step(actor_params, obs, key):
         actions, _ = actor_def.apply(actor_params, obs, key, method="sample_and_log_prob")
         return actions
+
+    policy_step = diag.instrument("policy_step", policy_step, kind="rollout")
 
     rb = ReplayBuffer(
         cfg.buffer.size,

@@ -105,12 +105,18 @@ def main(runtime, cfg):
         jax.tree_util.tree_map(np.asarray, params["actor"]), player_device
     )
 
-    train_step = make_train_step(actor_def, critic_def, optimizers, cfg, trainer_mesh, target_entropy)
+    train_step = diag.instrument(
+        "train_step",
+        make_train_step(actor_def, critic_def, optimizers, cfg, trainer_mesh, target_entropy),
+        kind="train",
+    )
 
     @jax.jit
     def _policy_step(actor_params, obs, key):
         actions, _ = actor_def.apply(actor_params, obs, key, method="sample_and_log_prob")
         return actions
+
+    _policy_step = diag.instrument("policy_step", _policy_step, kind="rollout")
 
     def policy_step(actor_params, obs, key):
         return _policy_step(actor_params, jax.device_put(obs, player_device), key)
@@ -163,7 +169,7 @@ def main(runtime, cfg):
                     if k in ("observations", "next_observations", "actions", "rewards", "terminated")
                 }
             data = diag.maybe_inject_nan(iter_num, data)
-            with diag.span("train"):
+            with diag.span("train", role="trainer"):
                 rng_key, scan_key = jax.random.split(rng_key)
                 keys = jax.random.split(scan_key, per_rank_gradient_steps)
                 params, opt_states, losses = train_step(params, opt_states, data, keys)
@@ -187,7 +193,7 @@ def main(runtime, cfg):
 
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
-        with timer("Time/env_interaction_time"), diag.span("rollout"):
+        with timer("Time/env_interaction_time"), diag.span("rollout", role="player"):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:

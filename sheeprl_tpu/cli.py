@@ -101,6 +101,21 @@ def check_configs(cfg: dotdict) -> None:
         )
     if cfg.metric.log_level not in (0, 1):
         raise ValueError(f"metric.log_level must be 0 or 1, got {cfg.metric.log_level}")
+    # telemetry knobs fail here, not hours into a run (the endpoint binds and
+    # the watchdog arms only after the log dir exists)
+    telemetry_cfg = (cfg.get("diagnostics") or {}).get("telemetry") or {}
+    http_cfg = telemetry_cfg.get("http") or {}
+    port = http_cfg.get("port", 0) or 0
+    if not isinstance(port, int) or port < 0 or port > 65535:
+        raise ValueError(
+            f"diagnostics.telemetry.http.port must be an integer in [0, 65535] (0 = ephemeral), got {port!r}"
+        )
+    watchdog_cfg = telemetry_cfg.get("watchdog") or {}
+    storm_threshold = watchdog_cfg.get("storm_threshold")
+    if storm_threshold is not None and int(storm_threshold) < 1:
+        raise ValueError(
+            f"diagnostics.telemetry.watchdog.storm_threshold must be >= 1, got {storm_threshold!r}"
+        )
     learning_starts = cfg.algo.get("learning_starts")
     if learning_starts is not None and learning_starts < 0:
         raise ValueError("The `algo.learning_starts` parameter must be greater or equal to zero")

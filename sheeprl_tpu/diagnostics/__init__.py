@@ -1,6 +1,6 @@
 """Run-health & observability subsystem.
 
-Three pillars behind one facade (ISSUE 1 tentpole):
+Four pillars behind one facade (ISSUE 1 tentpole + ISSUE 3 telemetry layer):
 
 * :mod:`~sheeprl_tpu.diagnostics.journal` — crash-safe JSONL run journal
   (write-ahead metric/event log; makes TensorBoard archaeology and the
@@ -10,13 +10,20 @@ Three pillars behind one facade (ISSUE 1 tentpole):
   divergence detector;
 * :mod:`~sheeprl_tpu.diagnostics.tracing` — step-phase Chrome-trace spans
   (rollout / buffer-sample / train / checkpoint) viewable in Perfetto,
-  complementing the device-side ``jax.profiler`` gate.
+  complementing the device-side ``jax.profiler`` gate, with run-id/rank/role
+  clock anchors so multi-process traces merge (``tools/trace_report.py``);
+* :mod:`~sheeprl_tpu.diagnostics.telemetry` — performance telemetry: a
+  recompilation watchdog over the instrumented jitted steps, MFU/goodput
+  accounting from compiled-step ``cost_analysis()`` FLOPs, phase-level
+  wall-clock attribution, and (opt-in) a live rank-0 ``/metrics`` +
+  ``/healthz`` HTTP endpoint (:mod:`~sheeprl_tpu.diagnostics.metrics_server`).
 
 The facade is constructed once in ``cli.run_algorithm`` from the
 ``configs/diagnostics/`` group and attached to the :class:`Runtime`; training
 loops pick it up through ``sheeprl_tpu.utils.utils.get_diagnostics`` and the
-rank-0 logger proxy journals every aggregated metric automatically, so
-non-flagship algorithms inherit journaling without loop changes.
+rank-0 logger proxy journals every aggregated metric automatically — augmented
+with the live ``Telemetry/*`` gauges — so non-flagship algorithms inherit
+journaling *and* perf telemetry without loop changes.
 """
 
 from __future__ import annotations
@@ -24,7 +31,7 @@ from __future__ import annotations
 import hashlib
 import os
 import warnings
-from contextlib import nullcontext
+from contextlib import contextmanager, nullcontext
 from typing import Any, Dict, Mapping, Optional
 
 from sheeprl_tpu.diagnostics.journal import JOURNAL_NAME, RunJournal, find_journal, iter_journal, read_journal
@@ -35,6 +42,7 @@ from sheeprl_tpu.diagnostics.sentinel import (
     poison_tree,
     sentinel_spec,
 )
+from sheeprl_tpu.diagnostics.telemetry import TELEMETRY_PREFIX, Telemetry, monitoring_available
 from sheeprl_tpu.diagnostics.tracing import TRACE_NAME, NullTracer, PhaseTracer
 
 __all__ = [
@@ -46,7 +54,9 @@ __all__ = [
     "RunJournal",
     "SentinelHalt",
     "SentinelSpec",
+    "TELEMETRY_PREFIX",
     "TRACE_NAME",
+    "Telemetry",
     "build_diagnostics",
     "config_hash",
     "find_journal",
@@ -65,8 +75,16 @@ def config_hash(cfg: Mapping[str, Any]) -> str:
     return hashlib.sha256(yaml.safe_dump(plain, sort_keys=True).encode()).hexdigest()[:16]
 
 
+def run_id_of(log_dir: str) -> str:
+    """Correlation id shared by every process of a run: the tail of the
+    (broadcast) log dir — ``<root_dir>/<run_name>/version_N`` — which is the
+    one string all ranks already agree on without extra rendezvous."""
+    parts = [p for p in os.path.normpath(str(log_dir)).split(os.sep) if p not in ("", ".")]
+    return "/".join(parts[-3:]) if parts else str(log_dir)
+
+
 class Diagnostics:
-    """Facade over journal + sentinel + tracer with rank-0 gating.
+    """Facade over journal + sentinel + tracer + telemetry with rank-0 gating.
 
     Construct via :func:`build_diagnostics`; call :meth:`open` once the run's
     log dir exists (``get_diagnostics`` does both).  Every method is a no-op
@@ -81,6 +99,7 @@ class Diagnostics:
         self.enabled = bool(diag_cfg.get("enabled", False))
         self._journal_cfg = diag_cfg.get("journal") or {}
         self._trace_cfg = diag_cfg.get("trace") or {}
+        self.role = str(diag_cfg.get("role") or "main")
         self.sentinel: SentinelSpec = sentinel_spec(cfg or {})
         div_cfg = (diag_cfg.get("sentinel") or {}).get("divergence") or {}
         self._detector: Optional[DivergenceDetector] = None
@@ -92,33 +111,56 @@ class Diagnostics:
                 entropy_key=div_cfg.get("entropy_key"),
                 entropy_floor=div_cfg.get("entropy_floor"),
             )
+        self.telemetry: Optional[Telemetry] = None
+        if self.enabled:
+            telemetry = Telemetry(cfg or {})
+            if telemetry.enabled:
+                self.telemetry = telemetry
         self.journal: Optional[RunJournal] = None
         self.tracer = NullTracer()
+        self.metrics_server = None
         self.log_dir: Optional[str] = None
+        self.run_id: Optional[str] = None
         self._rank_zero = True
         self._closed = False
 
     # -- lifecycle ---------------------------------------------------------
     def open(self, log_dir: str, rank_zero: bool = True) -> "Diagnostics":
-        """Open journal/tracer inside ``log_dir`` (idempotent, rank-0 only)."""
+        """Open journal/tracer/telemetry inside ``log_dir`` (idempotent;
+        journal + endpoint are rank-0 only, the tracer — when
+        ``trace.all_ranks`` — and the telemetry accounting run everywhere)."""
         if not self.enabled or self.log_dir is not None:
             return self
         self.log_dir = str(log_dir)
+        self.run_id = run_id_of(self.log_dir)
         self._rank_zero = bool(rank_zero)
-        if not self._rank_zero:
-            return self
-        if self._journal_cfg.get("enabled", True):
+        if self._trace_cfg.get("enabled", False) and (
+            self._rank_zero or self._trace_cfg.get("all_ranks", True)
+        ):
+            import jax
+
+            rank = jax.process_index()
+            if self._rank_zero:
+                trace_path = self._trace_cfg.get("path") or os.path.join(self.log_dir, TRACE_NAME)
+            else:
+                # an explicit trace.path must NOT be honored here: every rank
+                # would open the same file in 'w' mode and clobber the others
+                trace_path = os.path.join(self.log_dir, f"trace_rank{rank}.json")
+            self.tracer = PhaseTracer(
+                trace_path,
+                pid=rank,
+                max_events=self._trace_cfg.get("max_events"),
+                rotate_keep=int(self._trace_cfg.get("rotate_keep", 2)),
+                run_id=self.run_id,
+                role=self.role,
+            )
+        if self._rank_zero and self._journal_cfg.get("enabled", True):
             self.journal = RunJournal(
                 os.path.join(self.log_dir, JOURNAL_NAME),
                 fsync_every=int(self._journal_cfg.get("fsync_every", 1)),
             )
-        if self._trace_cfg.get("enabled", False):
-            trace_path = self._trace_cfg.get("path") or os.path.join(self.log_dir, TRACE_NAME)
-            import jax
-
-            self.tracer = PhaseTracer(trace_path, pid=jax.process_index())
+        cfg = self._cfg or {}
         if self.journal is not None:
-            cfg = self._cfg or {}
             self.journal.write(
                 "run_start",
                 config_hash=config_hash(cfg),
@@ -128,25 +170,112 @@ class Diagnostics:
                 exp_name=cfg.get("exp_name"),
                 run_name=cfg.get("run_name"),
                 log_dir=self.log_dir,
+                run_id=self.run_id,
                 sentinel_policy=self.sentinel.policy if self.sentinel.enabled else None,
             )
+        if self.telemetry is not None:
+            self.telemetry.open(
+                self._journal_event,
+                {
+                    "run_id": self.run_id,
+                    "algo": (cfg.get("algo") or {}).get("name"),
+                    "env": (cfg.get("env") or {}).get("id"),
+                    "role": self.role,
+                },
+            )
+            if self._rank_zero and self.telemetry.http_enabled:
+                self._start_metrics_server()
         return self
+
+    def _start_metrics_server(self) -> None:
+        from sheeprl_tpu.diagnostics.metrics_server import MetricsServer
+
+        try:
+            self.metrics_server = MetricsServer(
+                self._server_snapshot,
+                host=self.telemetry.http_host,
+                port=self.telemetry.http_port,
+            )
+            host, port = self.metrics_server.start()
+        except OSError as err:
+            # a taken port must not take the run down with it
+            self.metrics_server = None
+            warnings.warn(f"diagnostics metrics endpoint failed to bind: {err}", RuntimeWarning)
+            self._journal_event("metrics_server", status="bind_failed", error=str(err))
+            return
+        self._journal_event("metrics_server", status="serving", host=host, port=port)
+        print(f"Telemetry endpoint: http://{host}:{port}/metrics (and /healthz)", flush=True)
+
+    def _server_snapshot(self) -> Dict[str, Any]:
+        snap = self.telemetry.snapshot() if self.telemetry is not None else {}
+        if self.journal is not None and self.journal.last_write_t is not None:
+            import time
+
+            snap["journal_lag_seconds"] = round(time.time() - self.journal.last_write_t, 3)
+        return snap
+
+    def _journal_event(self, event: str, **fields: Any) -> None:
+        if self.journal is not None:
+            self.journal.write(event, **fields)
 
     def close(self, status: str = "completed") -> None:
         if self._closed:
             return
         self._closed = True
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
+        if self.telemetry is not None:
+            if self.journal is not None:
+                self.journal.write("telemetry_summary", **self.telemetry.summary())
+            self.telemetry.close()
         if self.journal is not None:
             self.journal.write("run_end", status=status)
             self.journal.close()
         self.tracer.close()
 
-    # -- tracing -----------------------------------------------------------
+    # -- tracing + phase accounting ----------------------------------------
     def span(self, name: str, **args: Any):
-        """Phase span context manager (no-op unless tracing is open)."""
-        if isinstance(self.tracer, NullTracer):
+        """Phase span context manager: feeds the telemetry phase-attribution
+        accumulator and (when tracing is open) the Chrome trace."""
+        tracing = not isinstance(self.tracer, NullTracer)
+        if self.telemetry is None and not tracing:
             return nullcontext()
-        return self.tracer.span(name, **args)
+        return self._span(name, args, tracing)
+
+    @contextmanager
+    def _span(self, name: str, args: Dict[str, Any], tracing: bool):
+        token = self.telemetry.span_enter(name) if self.telemetry is not None else None
+        try:
+            if tracing:
+                with self.tracer.span(name, **args):
+                    yield
+            else:
+                yield
+        finally:
+            if token is not None:
+                self.telemetry.span_exit(token)
+
+    # -- telemetry hooks ---------------------------------------------------
+    def instrument(self, name: str, fn, kind: str = "train"):
+        """Wrap a jitted step for the recompile watchdog + FLOPs accounting
+        (``kind="train"``) or signature-watch only (``kind="rollout"``).
+        Identity when telemetry is disabled."""
+        if self.telemetry is None:
+            return fn
+        return self.telemetry.instrument(name, fn, kind=kind)
+
+    def augment_metrics(self, step: Optional[int], metrics: Mapping[str, Any]) -> Mapping[str, Any]:
+        """Merge the interval's ``Telemetry/*`` gauges into an aggregated
+        metrics dict (called by the logger proxy before the backend logs)."""
+        if self.telemetry is None:
+            return metrics
+        extra = self.telemetry.interval_metrics(step)
+        if not extra:
+            return metrics
+        merged = dict(metrics)
+        merged.update(extra)
+        return merged
 
     # -- journal hooks -----------------------------------------------------
     def log_metrics(self, step: Optional[int], metrics: Mapping[str, Any]) -> None:
@@ -169,6 +298,8 @@ class Diagnostics:
         self.tracer.instant("checkpoint", step=step)
 
     def _journal_divergence(self, event: Dict[str, Any]) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count_sentinel_event()
         if self.journal is not None:
             kind = event.pop("kind", "unknown")
             step = event.pop("step", None)
@@ -243,9 +374,43 @@ class Diagnostics:
             self.journal.write("fault_injection", iter_num=int(iter_num))
         return poison_tree(tree)
 
+    def maybe_inject_shape_change(self, iter_num: int, tree, pad: int = 1):
+        """Shape-change fault injection for the recompile watchdog
+        (``diagnostics.telemetry.watchdog.inject_shape_change_iter``): pad the
+        leading axis of every array leaf by repeating its last row ``pad``
+        times at the configured loop iteration.  Only wired into the
+        PPO-family loops, whose minibatch indexing reads exactly
+        ``num_minibatches * batch_size`` rows — the padding rows are never
+        sampled, so training math is untouched while the dispatch signature
+        (and hence the compiled graph) genuinely changes.  ``pad`` defaults to
+        1; multi-device callers pass their data-axis divisor."""
+        telemetry = self.telemetry
+        if telemetry is None or telemetry.inject_shape_change_iter is None:
+            return tree
+        if int(iter_num) != telemetry.inject_shape_change_iter:
+            return tree
+        import jax
+        import jax.numpy as jnp
+
+        if self.journal is not None:
+            self.journal.write("fault_injection", iter_num=int(iter_num), kind="shape_change", pad=int(pad))
+
+        def pad_leaf(x):
+            if not hasattr(x, "shape") or not getattr(x, "shape", ()):  # scalars
+                return x
+            tail = jnp.repeat(x[-1:], int(pad), axis=0)
+            return jnp.concatenate([x, tail], axis=0)
+
+        return jax.tree_util.tree_map(pad_leaf, tree)
+
 
 def build_diagnostics(cfg: Optional[Mapping[str, Any]]) -> Diagnostics:
     """Construct the facade from a composed run config (never raises on a
     missing ``diagnostics`` section — direct entrypoint callers like bench.py
-    simply get a disabled facade)."""
-    return Diagnostics(cfg)
+    simply get a disabled facade).  Installs the process-wide compile-event
+    listener early so compiles that happen before the run dir exists (agent
+    build, warmup jits) are still counted."""
+    diagnostics = Diagnostics(cfg)
+    if diagnostics.telemetry is not None:
+        monitoring_available()
+    return diagnostics

@@ -84,10 +84,14 @@ class RunJournal:
         self._fp = open(self.path, "a", encoding="utf-8")
         self._count = 0
         self._closed = False
+        # wall-clock of the newest write: the /metrics endpoint exposes
+        # now - last_write_t as sheeprl_journal_lag_seconds (stall detector)
+        self.last_write_t: Optional[float] = None
 
     def write(self, event: str, **fields: Any) -> None:
         if self._closed:
             return
+        self.last_write_t = time.time()
         record: Dict[str, Any] = {"t": round(time.time(), 3), "event": str(event)}
         record.update(_sanitize(fields))
         self._fp.write(json.dumps(record, separators=(",", ":")) + "\n")

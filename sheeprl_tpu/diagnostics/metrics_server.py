@@ -1,0 +1,180 @@
+"""Rank-0 live metrics endpoint: ``/metrics`` (Prometheus text) + ``/healthz``.
+
+A stdlib ``ThreadingHTTPServer`` on a daemon thread — no new dependencies —
+serving the telemetry snapshot so external scrapers (Prometheus, or the
+``tools/run_monitor.py`` terminal dashboard in ``--url`` mode) can watch a
+live training run without touching its files.  Opt-in
+(``diagnostics.telemetry.http.enabled=True``); ``port: 0`` binds an ephemeral
+port, which the facade journals (``metrics_server`` event) and prints.
+
+The server never blocks training: handlers only read a lock-protected
+snapshot dict produced by :meth:`Telemetry.snapshot`, and shutdown is a
+bounded ``server.shutdown()`` + thread join inside ``Diagnostics.close``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_label(value: Any) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _metric_name(key: str) -> str:
+    """``Telemetry/phase_pct/train`` -> ``phase_pct_train`` etc."""
+    name = key.split("/", 1)[1] if key.startswith("Telemetry/") else key
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    name = "".join(out)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def render_prometheus(snapshot: Mapping[str, Any]) -> str:
+    """Prometheus text exposition (0.0.4) of a telemetry snapshot.
+
+    Gauges come from the latest closed accounting interval; ``*_total``
+    counters are cumulative over the run.  ``sheeprl_run_info`` carries the
+    run identity as labels (value is always 1), the standard info-metric
+    idiom.
+    """
+    lines = []
+
+    def emit(name: str, mtype: str, value: Any, help_text: str = "", labels: Optional[Dict] = None):
+        full = f"sheeprl_{name}"
+        if help_text:
+            lines.append(f"# HELP {full} {help_text}")
+        lines.append(f"# TYPE {full} {mtype}")
+        label_s = ""
+        if labels:
+            inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items()))
+            label_s = "{" + inner + "}"
+        try:
+            num = float(value)
+        except (TypeError, ValueError):
+            num = 0.0
+        lines.append(f"{full}{label_s} {num:g}")
+
+    info = snapshot.get("info") or {}
+    if info:
+        full = "sheeprl_run_info"
+        lines.append(f"# HELP {full} Run identity (labels carry the data; value is 1).")
+        lines.append(f"# TYPE {full} gauge")
+        inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in sorted(info.items()) if v is not None)
+        lines.append(f"{full}{{{inner}}} 1")
+
+    emit("up", "gauge", 1, "1 while the training process serves this endpoint.")
+    steps = snapshot.get("policy_steps")
+    if steps is not None:
+        emit("policy_steps_total", "counter", steps, "Policy steps taken (env frames / action_repeat).")
+
+    for key, value in sorted((snapshot.get("gauges") or {}).items()):
+        if value is None:
+            continue
+        emit(_metric_name(key), "gauge", value)
+
+    for key, value in sorted((snapshot.get("counters") or {}).items()):
+        emit(key, "counter", value)
+
+    phase_seconds = snapshot.get("phase_seconds_total") or {}
+    if phase_seconds:
+        # one TYPE line for the whole label family — a second TYPE line for
+        # the same metric name is a Prometheus parse error
+        lines.append("# TYPE sheeprl_phase_seconds_total counter")
+        for phase, secs in sorted(phase_seconds.items()):
+            try:
+                num = float(secs)
+            except (TypeError, ValueError):
+                num = 0.0
+            lines.append(f'sheeprl_phase_seconds_total{{phase="{_escape_label(phase)}"}} {num:g}')
+
+    lag = snapshot.get("journal_lag_seconds")
+    if lag is not None:
+        emit(
+            "journal_lag_seconds",
+            "gauge",
+            lag,
+            "Seconds since the last journal write (high = run stalled or not logging).",
+        )
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Background HTTP server bound to ``host:port`` (0 = ephemeral)."""
+
+    def __init__(self, snapshot_fn: Callable[[], Dict[str, Any]], host: str = "127.0.0.1", port: int = 0):
+        self._snapshot_fn = snapshot_fn
+        self._host = host
+        self._port = int(port)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> Tuple[str, int]:
+        snapshot_fn = self._snapshot_fn
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:  # silence stderr spam
+                pass
+
+            def do_GET(self) -> None:  # noqa: N802 - stdlib API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        body = render_prometheus(snapshot_fn()).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                    elif path == "/healthz":
+                        snap = snapshot_fn()
+                        body = json.dumps(
+                            {
+                                "status": "ok",
+                                "t": round(time.time(), 3),
+                                "policy_steps": snap.get("policy_steps"),
+                                "journal_lag_seconds": snap.get("journal_lag_seconds"),
+                            }
+                        ).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                    else:
+                        body = b"not found\n"
+                        self.send_response(404)
+                        self.send_header("Content-Type", "text/plain")
+                except Exception as err:  # pragma: no cover - snapshot races
+                    body = f"snapshot error: {err!r}\n".encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="sheeprl-metrics-server", daemon=True
+        )
+        self._thread.start()
+        return self.address
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        assert self._server is not None, "MetricsServer not started"
+        host, port = self._server.server_address[:2]
+        return str(host), int(port)
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

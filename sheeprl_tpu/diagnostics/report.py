@@ -1,4 +1,5 @@
-"""Journal analysis: the library behind ``tools/journal_report.py``.
+"""Journal analysis: the library behind ``tools/journal_report.py`` and the
+live formatting shared with ``tools/run_monitor.py``.
 
 Everything a post-mortem needs without TensorBoard archaeology: run identity
 and config hash, the last logged step counter and metric values (including
@@ -11,6 +12,7 @@ from __future__ import annotations
 
 import csv
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 from sheeprl_tpu.diagnostics.journal import find_journal, read_journal
@@ -129,4 +131,97 @@ def format_summary(summary: Dict[str, Any]) -> str:
             )
     else:
         lines.append("divergence events: none")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# live formatting (shared by journal_report --follow and run_monitor)
+
+_TELEMETRY_COLUMNS = (
+    ("Rewards/rew_avg", "rew", "{:.2f}"),
+    ("Telemetry/sps", "sps", "{:.0f}"),
+    ("Telemetry/tflops_per_sec", "tflops", "{:.2f}"),
+    ("Telemetry/mfu", "mfu", "{:.1%}"),
+)
+
+
+def _phase_summary(metrics: Dict[str, Any]) -> Optional[str]:
+    phases = {
+        k.rsplit("/", 1)[1]: v
+        for k, v in metrics.items()
+        if k.startswith("Telemetry/phase_pct/") and isinstance(v, (int, float))
+    }
+    if not phases:
+        return None
+    order = ("train", "env", "fetch", "other", "idle")
+    keys = [k for k in order if k in phases] + sorted(set(phases) - set(order))
+    return " ".join(f"{k}:{phases[k]:.0f}%" for k in keys)
+
+
+def format_event_line(event: Dict[str, Any]) -> str:
+    """One journal event as one compact terminal line (the tail/monitor
+    format)."""
+    t = event.get("t")
+    clock = time.strftime("%H:%M:%S", time.localtime(t)) if isinstance(t, (int, float)) else "--:--:--"
+    kind = str(event.get("event", "?"))
+    if kind == "metrics":
+        metrics = event.get("metrics") or {}
+        parts = [f"step {event.get('step')}"]
+        for key, label, fmt in _TELEMETRY_COLUMNS:
+            value = metrics.get(key)
+            if isinstance(value, (int, float)):
+                parts.append(f"{label} {fmt.format(value)}")
+        phases = _phase_summary(metrics)
+        if phases:
+            parts.append(phases)
+        recompiles = metrics.get("Telemetry/recompiles")
+        if isinstance(recompiles, (int, float)) and recompiles > 0:
+            parts.append(f"recompiles {recompiles:g}")
+        return f"[{clock}] {kind:<12s} " + "  ".join(parts)
+    payload = {k: v for k, v in event.items() if k not in ("t", "event")}
+    if kind == "recompile":
+        diff = payload.get("diff") or []
+        head = "; ".join(str(d) for d in diff[:3])
+        return f"[{clock}] {kind:<12s} {payload.get('fn')} #{payload.get('count')}: {head}"
+    if kind == "divergence":
+        return f"[{clock}] {kind:<12s} step {payload.get('step')}: {payload.get('kind')}"
+    detail = " ".join(f"{k}={v}" for k, v in payload.items() if not isinstance(v, (dict, list)))
+    return f"[{clock}] {kind:<12s} {detail}".rstrip()
+
+
+def status_block(events: List[Dict[str, Any]]) -> str:
+    """Multi-line run status from a journal event list (run_monitor's view)."""
+    run_start = next((e for e in events if e.get("event") == "run_start"), None)
+    run_end = next((e for e in reversed(events) if e.get("event") == "run_end"), None)
+    metrics_events = [e for e in events if e.get("event") == "metrics"]
+    last = metrics_events[-1] if metrics_events else None
+    lines = []
+    if run_start:
+        lines.append(
+            "run     {algo} on {env} (seed {seed})  id={rid}".format(
+                algo=run_start.get("algo", "?"),
+                env=run_start.get("env", "?"),
+                seed=run_start.get("seed", "?"),
+                rid=run_start.get("run_id", run_start.get("config_hash", "?")),
+            )
+        )
+    age = None
+    if events:
+        newest = max((e.get("t") for e in events if isinstance(e.get("t"), (int, float))), default=None)
+        if newest is not None:
+            age = time.time() - newest
+    state = f"ended: {run_end.get('status')}" if run_end else "running"
+    if age is not None and run_end is None:
+        state += f" (last journal write {age:.0f}s ago)"
+    lines.append(f"state   {state}")
+    if last:
+        lines.append(format_event_line(last))
+    server = next((e for e in reversed(events) if e.get("event") == "metrics_server"), None)
+    if server and server.get("status") == "serving":
+        lines.append(f"metrics http://{server.get('host')}:{server.get('port')}/metrics")
+    n_div = sum(1 for e in events if e.get("event") == "divergence")
+    n_rec = sum(1 for e in events if e.get("event") == "recompile")
+    n_ckpt = sum(1 for e in events if e.get("event") == "checkpoint")
+    lines.append(f"events  {len(events)} total · {len(metrics_events)} intervals · "
+                 f"{n_ckpt} checkpoints · {n_rec} recompiles · {n_div} divergences")
     return "\n".join(lines)

@@ -11,6 +11,19 @@ Crash behaviour mirrors the journal: every event is flushed as written and
 the closing ``]`` only lands in :meth:`PhaseTracer.close` — both Chrome and
 Perfetto explicitly accept a truncated (unterminated) trace array, so a
 SIGKILL'd run still leaves a loadable trace.
+
+Cross-process correlation (ISSUE 3): every trace file opens with a
+``clock_sync`` instant carrying the run id, rank, role and the Unix-epoch
+microsecond corresponding to ``ts=0`` of this file's monotonic clock.
+``tools/trace_report.py`` uses those anchors to merge traces written by
+different processes (multi-host ranks, or a decoupled player/trainer pair)
+onto one absolute timeline.
+
+Growth cap: ``max_events`` rotates the file (``trace.json`` →
+``trace.json.1`` → ``.2`` …, keeping ``rotate_keep`` rotated generations).
+Each rotated generation is a *complete*, Perfetto-loadable JSON array with its
+own metadata preamble, and the monotonic ``ts`` values continue across
+generations, so rotated files can be merged back into one timeline.
 """
 
 from __future__ import annotations
@@ -43,28 +56,68 @@ KNOWN_PHASES = (
 class PhaseTracer:
     """Streaming Trace-Event writer with a ``span`` context manager."""
 
-    def __init__(self, path: str, pid: int = 0, flush_every: int = 1):
+    def __init__(
+        self,
+        path: str,
+        pid: int = 0,
+        flush_every: int = 1,
+        max_events: Optional[int] = None,
+        rotate_keep: int = 2,
+        run_id: Optional[str] = None,
+        role: Optional[str] = None,
+    ):
         self.path = str(path)
         self._pid = int(pid)
         self._flush_every = max(1, int(flush_every))
+        self._max_events = int(max_events) if max_events else None
+        self._rotate_keep = max(1, int(rotate_keep))
+        self.run_id = run_id
+        self.role = role or "main"
         os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
-        self._fp = open(self.path, "w", encoding="utf-8")
-        self._fp.write("[\n")
-        self._first = True
         self._count = 0
         self._closed = False
         self._lock = threading.Lock()
-        # perf_counter origin so ts deltas are monotonic within the run
+        # perf_counter origin so ts deltas are monotonic within the run; the
+        # paired wall-clock reading anchors ts=0 on the Unix epoch for the
+        # cross-process merge (taken back-to-back: sub-ms anchor skew)
         self._t0_ns = time.perf_counter_ns()
-        self._emit(
+        self._epoch_t0_us = time.time_ns() // 1000
+        self._fp = open(self.path, "w", encoding="utf-8")
+        self._fp.write("[\n")
+        self._first = True
+        self._write_preamble()
+
+    def _preamble_events(self):
+        return (
             {
                 "name": "process_name",
                 "ph": "M",
                 "pid": self._pid,
                 "tid": 0,
-                "args": {"name": f"sheeprl_tpu host {self._pid}"},
-            }
+                "args": {"name": f"sheeprl_tpu {self.role} rank{self._pid}"},
+            },
+            {
+                "name": "clock_sync",
+                "cat": "meta",
+                "ph": "i",
+                "s": "g",
+                "ts": self._now_us(),
+                "pid": self._pid,
+                "tid": 0,
+                "args": {
+                    "run_id": self.run_id,
+                    "rank": self._pid,
+                    "role": self.role,
+                    # Unix-epoch µs at this file's ts=0: merge key for
+                    # tools/trace_report.py (abs_us = epoch_t0_us + ts)
+                    "epoch_t0_us": self._epoch_t0_us,
+                },
+            },
         )
+
+    def _write_preamble(self) -> None:
+        for event in self._preamble_events():
+            self._emit(event)
 
     def _now_us(self) -> int:
         return (time.perf_counter_ns() - self._t0_ns) // 1000
@@ -73,6 +126,8 @@ class PhaseTracer:
         if self._closed:
             return
         with self._lock:
+            if self._closed:  # re-check: close() may have won the lock race
+                return
             if not self._first:
                 self._fp.write(",\n")
             self._first = False
@@ -80,6 +135,45 @@ class PhaseTracer:
             self._count += 1
             if self._count % self._flush_every == 0:
                 self._fp.flush()
+            if self._max_events is not None and self._count >= self._max_events:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Close the current generation as a complete array and start a new
+        one (caller holds the lock).  ``ts`` keeps counting from the same
+        origin, so generations concatenate into one coherent timeline."""
+        try:
+            self._fp.write("\n]\n")
+            self._fp.flush()
+        finally:
+            self._fp.close()
+        for i in range(self._rotate_keep - 1, 0, -1):
+            older = f"{self.path}.{i}"
+            if os.path.exists(older):
+                os.replace(older, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        # drop any generation beyond the keep budget
+        overflow = f"{self.path}.{self._rotate_keep + 1}"
+        if os.path.exists(overflow):
+            os.remove(overflow)
+        self._fp = open(self.path, "w", encoding="utf-8")
+        self._fp.write("[\n")
+        self._first = True
+        self._count = 0
+        # new generation gets its own preamble (same run/clock identity) so
+        # it is independently loadable; written directly — the lock is held
+        self._write_preamble_direct()
+
+    def _write_preamble_direct(self) -> None:
+        """Write the metadata preamble straight to the (fresh) file while the
+        lock is already held."""
+        for event in self._preamble_events():
+            if not self._first:
+                self._fp.write(",\n")
+            self._first = False
+            self._fp.write(json.dumps(event, separators=(",", ":")))
+            self._count += 1
+        self._fp.flush()
 
     @contextmanager
     def span(self, name: str, **args: Any):
@@ -117,15 +211,16 @@ class PhaseTracer:
         )
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        try:
-            self._fp.write("\n]\n")
-            self._fp.flush()
-        except ValueError:  # pragma: no cover - interpreter teardown
-            pass
-        self._fp.close()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._fp.write("\n]\n")
+                self._fp.flush()
+            except ValueError:  # pragma: no cover - interpreter teardown
+                pass
+            self._fp.close()
 
 
 class NullTracer:

@@ -179,8 +179,14 @@ class JournalingLogger(NoOpLogger):
         return self._inner.name
 
     def log_metrics(self, metrics: Dict[str, Any], step: Optional[int] = None) -> None:
-        self._inner.log_metrics(metrics, step)
         diagnostics = getattr(self._runtime, "diagnostics", None)
+        if diagnostics is not None:
+            # close the telemetry accounting interval and merge its live
+            # Telemetry/* gauges (MFU, tflops/s, sps, phase breakdown) so the
+            # TensorBoard/W&B backend AND the journal both receive them —
+            # every algorithm inherits perf telemetry through this one proxy
+            metrics = diagnostics.augment_metrics(step, metrics)
+        self._inner.log_metrics(metrics, step)
         if diagnostics is not None:
             diagnostics.log_metrics(step, metrics)
 

@@ -10,7 +10,11 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
   models, env layer (incl. `tests/test_envs/test_async_pipeline.py`: the
   split-phase executor goldens, shm-worker crash recovery, overlap timing,
   and the `executor=shared_memory` CLI smokes), config/CLI utils,
-  sharding-HLO checks.  ~8 min on one CPU core.  Budget: 25 min.
+  sharding-HLO checks, and the diagnostics suite
+  (`tests/test_diagnostics/`: journal/sentinel/tracing plus
+  `test_telemetry.py` — recompile watchdog, MFU/phase math, /metrics
+  endpoint, trace merge, and the telemetry CLI e2e).  ~8 min on one CPU
+  core.  Budget: 25 min.
 * **e2e** — `tests/test_algos/` drives every algorithm through the real CLI
   on dummy envs at 1 and 2 virtual devices.  Slow by nature (each test
   compiles a train step).  Budget: 40 min.

@@ -96,14 +96,16 @@ def test_facade_run_lifecycle_and_config_hash(tmp_path):
     diag.close("completed")
     events = read_journal(str(tmp_path / "journal.jsonl"))
     kinds = [e["event"] for e in events]
-    assert kinds == ["run_start", "metrics", "checkpoint", "run_end"]
+    # telemetry (default-on since ISSUE 3) closes with a cumulative summary
+    # right before run_end
+    assert kinds == ["run_start", "metrics", "checkpoint", "telemetry_summary", "run_end"]
     start = events[0]
     assert start["algo"] == "ppo" and start["env"] == "discrete_dummy"
     assert len(start["config_hash"]) == 16
     assert events[-1]["status"] == "completed"
     # close is idempotent and open-once: no duplicate run_end
     diag.close("again")
-    assert len(read_journal(str(tmp_path / "journal.jsonl"))) == 4
+    assert len(read_journal(str(tmp_path / "journal.jsonl"))) == len(kinds)
 
 
 def test_disabled_facade_is_inert(tmp_path):

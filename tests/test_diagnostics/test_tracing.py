@@ -31,8 +31,11 @@ def test_trace_is_valid_json_with_complete_events(tmp_path):
     train, rollout = spans
     assert rollout["ts"] <= train["ts"]
     assert train["ts"] + train["dur"] <= rollout["ts"] + rollout["dur"]
-    (mark,) = [e for e in events if e.get("ph") == "i"]
+    (mark,) = [e for e in events if e.get("ph") == "i" and e.get("cat") == "event"]
     assert mark["name"] == "checkpoint" and mark["args"]["step"] == 16
+    # every file opens with a clock_sync anchor (cross-process merge key)
+    (sync,) = [e for e in events if e.get("name") == "clock_sync"]
+    assert isinstance(sync["args"]["epoch_t0_us"], int)
 
 
 def test_crashed_trace_is_still_loadable(tmp_path):

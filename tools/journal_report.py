@@ -10,9 +10,12 @@ Usage:
     python tools/journal_report.py logs/runs/ppo/CartPole-v1/<run>/
     python tools/journal_report.py path/to/journal.jsonl --csv rewards.csv
     python tools/journal_report.py <run dir> --json        # machine-readable
+    python tools/journal_report.py <run dir> --follow      # live tail
 
 Accepts a journal file, a ``version_N`` directory, or any run-dir ancestor
-(the newest journal below wins).
+(the newest journal below wins).  ``--follow`` streams every journal row —
+including the live ``Telemetry/*`` gauges — as the compact one-line format
+shared with ``tools/run_monitor.py``, until the run ends or Ctrl-C.
 """
 
 from __future__ import annotations
@@ -25,7 +28,28 @@ import sys
 # runnable straight from a checkout: tools/ is not a package
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from sheeprl_tpu.diagnostics.report import format_summary, summarize, to_csv  # noqa: E402
+from sheeprl_tpu.diagnostics.journal import find_journal  # noqa: E402
+from sheeprl_tpu.diagnostics.report import format_event_line, format_summary, summarize, to_csv  # noqa: E402
+
+
+def follow(path: str, interval: float) -> int:
+    """Tail mode: replay the journal so far, then stream new rows."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from run_monitor import tail_journal  # sibling tool; same tail + formatting
+
+    journal_path = find_journal(path)
+    if journal_path is None:
+        print(f"error: no journal.jsonl found under '{path}'", file=sys.stderr)
+        return 2
+    print(f"journal: {journal_path}", flush=True)
+    try:
+        for event in tail_journal(journal_path, poll_s=interval, follow=True):
+            print(format_event_line(event), flush=True)
+            if event.get("event") == "run_end":
+                return 0
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def main() -> int:
@@ -33,7 +57,12 @@ def main() -> int:
     parser.add_argument("path", help="journal.jsonl, a version_N dir, or a run dir")
     parser.add_argument("--csv", metavar="OUT", help="export the metric history to OUT as CSV")
     parser.add_argument("--json", action="store_true", help="print the summary as JSON instead of text")
+    parser.add_argument("--follow", "-f", action="store_true", help="tail mode: stream journal rows live")
+    parser.add_argument("--interval", type=float, default=0.5, help="tail poll interval in seconds")
     args = parser.parse_args()
+
+    if args.follow:
+        return follow(args.path, args.interval)
 
     try:
         summary = summarize(args.path)

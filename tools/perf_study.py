@@ -51,6 +51,7 @@ def measure_env_host(sleep_ms: float = 50.0, iters: int = 20, host_work_ms: floa
     critical path (≈ min(sleep_ms, host_work_ms))."""
     import numpy as np
 
+    from sheeprl_tpu.diagnostics.telemetry import Telemetry
     from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
     from sheeprl_tpu.envs.env import vectorized_env
     from sheeprl_tpu.envs.pipeline import PipelinedVectorEnv
@@ -61,19 +62,29 @@ def measure_env_host(sleep_ms: float = 50.0, iters: int = 20, host_work_ms: floa
     envs = PipelinedVectorEnv(vectorized_env([mk], sync=True))
     envs.reset(seed=0)
     actions = np.zeros(1, np.int64)
+    # the live layer's own phase accounting (same Telemetry/phase_pct/* field
+    # names a run journals), so this offline line diffs against live rows
+    tele = Telemetry({})
+    tele.open()
     step_s = async_s = wait_s = 0.0
     for _ in range(iters):  # serialized: the whole env latency is host time
         t0 = time.perf_counter()
         envs.step(actions)
         step_s += time.perf_counter() - t0
+    tele.interval_metrics(None)  # phase window covers the pipelined loop only
     for _ in range(iters):  # pipelined: issue, overlap host work, collect
         t0 = time.perf_counter()
-        envs.step_async(actions)
+        with tele.span("env_step_async"):
+            envs.step_async(actions)
         async_s += time.perf_counter() - t0
-        time.sleep(host_work_ms / 1e3)  # stand-in for train dispatch + fetch
+        with tele.span("train"):
+            time.sleep(host_work_ms / 1e3)  # stand-in for train dispatch + fetch
         t0 = time.perf_counter()
-        envs.step_wait()
+        with tele.span("env_wait"):
+            envs.step_wait()
         wait_s += time.perf_counter() - t0
+    phases = tele.interval_metrics(None)
+    tele.close()  # detach from the process-global compile-listener registry
     envs.close()
     env_step_ms = step_s / iters * 1e3
     env_wait_ms = wait_s / iters * 1e3
@@ -85,6 +96,7 @@ def measure_env_host(sleep_ms: float = 50.0, iters: int = 20, host_work_ms: floa
         "env_step_async_ms": round(async_s / iters * 1e3, 3),
         "env_wait_ms": round(env_wait_ms, 2),
         "hidden_ms": round(env_step_ms - env_wait_ms, 2),
+        **{k: round(v, 2) for k, v in phases.items() if k.startswith("Telemetry/phase_pct/")},
     }
 
 

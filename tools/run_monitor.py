@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Terminal dashboard for a live (or finished) training run.
+
+Two sources, same view:
+
+* **journal mode** (default): tail the run's crash-safe ``journal.jsonl`` —
+  works on any run, local or NFS-mounted, no ports needed;
+* **endpoint mode** (``--url http://host:port``): poll the run's live
+  ``/metrics`` endpoint (``diagnostics.telemetry.http.enabled=True``) — works
+  across machines without filesystem access.
+
+Shows run identity and state, the latest metric interval (reward, SPS,
+TFLOP/s, MFU, phase breakdown), recompile/divergence counters and — with
+``--follow`` — streams every new journal row as a compact line
+(``tools/journal_report.py --follow`` shares this exact formatting).
+
+Usage:
+    python tools/run_monitor.py logs/runs/ppo/CartPole-v1/<run>/
+    python tools/run_monitor.py <run dir> --follow
+    python tools/run_monitor.py --url http://127.0.0.1:8765 --follow
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+# runnable straight from a checkout: tools/ is not a package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.diagnostics.journal import find_journal  # noqa: E402
+from sheeprl_tpu.diagnostics.report import format_event_line, status_block  # noqa: E402
+
+_PROM_LINE = re.compile(r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?P<labels>\{[^}]*\})?\s+(?P<value>\S+)$")
+
+
+def tail_journal(path: str, poll_s: float = 0.5, follow: bool = True) -> Iterator[Dict[str, Any]]:
+    """Yield journal events as they land (byte-offset tail; a partial trailing
+    line is left in the buffer until its newline arrives)."""
+    offset = 0
+    buffer = ""
+    while True:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size < offset:  # truncated/rotated from under us: start over
+            offset, buffer = 0, ""
+        if size > offset:
+            with open(path, encoding="utf-8") as fp:
+                fp.seek(offset)
+                chunk = fp.read()
+                offset = fp.tell()
+            buffer += chunk
+            while "\n" in buffer:
+                line, buffer = buffer.split("\n", 1)
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(event, dict):
+                    yield event
+        elif not follow:
+            return
+        else:
+            time.sleep(poll_s)
+        if not follow and size <= offset and not buffer:
+            return
+
+
+def parse_prometheus(text: str) -> Dict[str, Any]:
+    """Minimal Prometheus text parse: ``{metric: value}`` plus label sets for
+    the info/phase metrics (enough for the dashboard, not a full parser)."""
+    out: Dict[str, Any] = {"_labels": {}}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        match = _PROM_LINE.match(line)
+        if not match:
+            continue
+        name = match.group("name")
+        labels_raw = match.group("labels") or ""
+        labels = dict(re.findall(r'(\w+)="([^"]*)"', labels_raw))
+        try:
+            value = float(match.group("value"))
+        except ValueError:
+            continue
+        if labels:
+            out["_labels"].setdefault(name, []).append((labels, value))
+        out[name] = value
+    return out
+
+
+def endpoint_status(url: str) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url.rstrip("/") + "/metrics", timeout=5) as resp:
+        metrics = parse_prometheus(resp.read().decode())
+    lines = []
+    info_sets = metrics["_labels"].get("sheeprl_run_info") or []
+    if info_sets:
+        info = info_sets[0][0]
+        lines.append(
+            "run     {algo} on {env}  id={rid}  role={role}".format(
+                algo=info.get("algo", "?"),
+                env=info.get("env", "?"),
+                rid=info.get("run_id", "?"),
+                role=info.get("role", "?"),
+            )
+        )
+    lag = metrics.get("sheeprl_journal_lag_seconds")
+    state = "serving"
+    if lag is not None:
+        state += f" (last journal write {lag:.0f}s ago)"
+    lines.append(f"state   {state}")
+    parts = []
+    steps = metrics.get("sheeprl_policy_steps_total")
+    if steps is not None:
+        parts.append(f"step {steps:g}")
+    for key, label, fmt in (
+        ("sheeprl_sps", "sps", "{:.0f}"),
+        ("sheeprl_tflops_per_sec", "tflops", "{:.2f}"),
+        ("sheeprl_mfu", "mfu", "{:.1%}"),
+    ):
+        value = metrics.get(key)
+        if value is not None:
+            parts.append(f"{label} {fmt.format(value)}")
+    phases = sorted(
+        (name[len("sheeprl_phase_pct_"):], value)
+        for name, value in metrics.items()
+        if name.startswith("sheeprl_phase_pct_")
+    )
+    if phases:
+        parts.append(" ".join(f"{k}:{v:.0f}%" for k, v in phases))
+    if parts:
+        lines.append("latest  " + "  ".join(parts))
+    counters = []
+    for key, label in (
+        ("sheeprl_recompiles_total", "recompiles"),
+        ("sheeprl_recompile_storms_total", "storms"),
+        ("sheeprl_sentinel_events_total", "sentinel events"),
+        ("sheeprl_backend_compiles_total", "compiles"),
+    ):
+        value = metrics.get(key)
+        if value is not None:
+            counters.append(f"{value:g} {label}")
+    if counters:
+        lines.append("totals  " + " · ".join(counters))
+    return "\n".join(lines)
+
+
+def run_journal_mode(path: str, follow: bool, interval: float) -> int:
+    journal_path = find_journal(path)
+    if journal_path is None:
+        print(f"error: no journal.jsonl found under '{path}'", file=sys.stderr)
+        return 2
+    events: List[Dict[str, Any]] = list(tail_journal(journal_path, follow=False))
+    print(f"journal: {journal_path}")
+    print(status_block(events))
+    if not follow:
+        return 0
+    print("-" * 72)
+    # stream rows from where the snapshot stopped
+    seen = len(events)
+    try:
+        for i, event in enumerate(tail_journal(journal_path, poll_s=interval, follow=True)):
+            if i < seen:
+                continue
+            print(format_event_line(event), flush=True)
+            if event.get("event") == "run_end":
+                return 0
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def run_endpoint_mode(url: str, follow: bool, interval: float) -> int:
+    while True:
+        try:
+            block = endpoint_status(url)
+        except Exception as err:
+            print(f"error: {url} unreachable: {err}", file=sys.stderr)
+            return 2 if not follow else 0
+        stamp = time.strftime("%H:%M:%S")
+        print(f"[{stamp}] {url}")
+        print(block, flush=True)
+        if not follow:
+            return 0
+        print("-" * 72)
+        try:
+            time.sleep(max(0.2, interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", nargs="?", help="run dir or journal.jsonl (journal mode)")
+    parser.add_argument("--url", help="live /metrics endpoint base URL (endpoint mode)")
+    parser.add_argument("--follow", "-f", action="store_true", help="keep watching for new rows")
+    parser.add_argument("--interval", type=float, default=2.0, help="poll interval in seconds")
+    args = parser.parse_args()
+
+    if bool(args.url) == bool(args.path):
+        parser.error("pass exactly one of: a run path, or --url")
+    if args.url:
+        return run_endpoint_mode(args.url, args.follow, args.interval)
+    return run_journal_mode(args.path, args.follow, args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

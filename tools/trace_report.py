@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Merge Chrome phase traces from multiple processes and report per-phase time.
+
+Every trace the diagnostics tracer writes (``diagnostics.trace.enabled=True``)
+opens with a ``clock_sync`` instant whose ``epoch_t0_us`` anchors that file's
+monotonic ``ts`` values on the Unix epoch, and names the run id, rank and role
+(player / trainer / main).  This tool uses those anchors to:
+
+* merge traces written by different processes — a decoupled player + trainer
+  pair, or the per-rank ``trace_rank{N}.json`` files of a multihost run — into
+  ONE Chrome/Perfetto-loadable timeline (``--out merged.json``), and
+* print the per-phase wall-clock table (count / total / mean / share per
+  role) that PERF.md §3 used to hand-compute from isolated runs.
+
+Accepts trace files, run directories (all ``trace*.json`` below are taken,
+rotated ``.1``/``.2`` generations included) and crash-truncated files (the
+unterminated-array form a SIGKILL leaves).
+
+Usage:
+    python tools/trace_report.py logs/runs/.../version_0/
+    python tools/trace_report.py player/trace.json trainer/trace.json --out merged.json
+    python tools/trace_report.py <run dir> --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# runnable straight from a checkout: tools/ is not a package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_trace(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Load one trace file (complete or crash-truncated array).
+
+    Returns ``(meta, events)`` where ``meta`` comes from the file's
+    ``clock_sync`` anchor (``{run_id, rank, role, epoch_t0_us}``).
+    """
+    raw = open(path, encoding="utf-8").read().strip()
+    if not raw:
+        return {}, []
+    if raw.endswith("]"):
+        events = json.loads(raw)
+    else:
+        # SIGKILL'd writer: unterminated streaming array, possibly ending in a
+        # half-serialized event — drop trailing lines until the array parses
+        lines = raw.splitlines()
+        events = []
+        while lines:
+            candidate = "\n".join(lines).rstrip().rstrip(",") + "\n]"
+            try:
+                events = json.loads(candidate)
+                break
+            except json.JSONDecodeError:
+                lines.pop()
+    meta: Dict[str, Any] = {}
+    for event in events:
+        if event.get("name") == "clock_sync":
+            meta = dict(event.get("args") or {})
+            break
+    return meta, events
+
+
+def collect_trace_files(paths: List[str]) -> List[str]:
+    """Expand run dirs into their trace files; include rotated generations."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _, files in os.walk(path):
+                for name in sorted(files):
+                    if re.fullmatch(r"trace.*\.json(\.\d+)?", name):
+                        out.append(os.path.join(root, name))
+        else:
+            out.append(path)
+            for rotated in sorted(glob.glob(path + ".[0-9]*")):
+                out.append(rotated)
+    # stable de-dup
+    seen, unique = set(), []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            unique.append(p)
+    return unique
+
+
+def merge_traces(paths: List[str]) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """Merge trace files onto one absolute timeline.
+
+    Returns ``(merged_events, sources)``.  Each merged event gains
+    ``abs_us`` (Unix-epoch µs) plus the source ``role``/``rank``; ``ts`` is
+    rebased so the earliest event across all files sits at 0, and each source
+    file keeps a distinct ``pid`` so Perfetto shows one track group per
+    process.  Files without a ``clock_sync`` anchor fall back to their own
+    ``ts`` (mergeable only with files from the same clock).
+    """
+    loaded = []
+    for path in paths:
+        meta, events = load_trace(path)
+        if events:
+            loaded.append((path, meta, events))
+    merged: List[Dict[str, Any]] = []
+    sources: List[Dict[str, Any]] = []
+    for pid, (path, meta, events) in enumerate(loaded):
+        anchor = int(meta.get("epoch_t0_us", 0))
+        role = str(meta.get("role") or f"proc{pid}")
+        rank = meta.get("rank", pid)
+        sources.append(
+            {
+                "path": path,
+                "run_id": meta.get("run_id"),
+                "role": role,
+                "rank": rank,
+                "epoch_t0_us": anchor,
+                "n_events": len(events),
+            }
+        )
+        for event in events:
+            if event.get("ph") == "M":
+                continue  # regenerated below with role-qualified names
+            e = dict(event)
+            e["abs_us"] = anchor + int(e.get("ts", 0))
+            e["pid"] = pid
+            e.setdefault("args", {})
+            e["args"] = {**e["args"], "role": role, "rank": rank}
+            merged.append(e)
+    if not merged:
+        return [], sources
+    t0 = min(e["abs_us"] for e in merged)
+    for e in merged:
+        e["ts"] = e["abs_us"] - t0
+    merged.sort(key=lambda e: e["ts"])
+    # one process_name metadata event per source so the merged file is
+    # self-describing in the Perfetto UI
+    preamble = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": f"{src['role']} rank{src['rank']} ({os.path.basename(src['path'])})"},
+        }
+        for pid, src in enumerate(sources)
+    ]
+    return preamble + merged, sources
+
+
+def phase_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per (role, phase) wall-clock aggregation over merged span events."""
+    spans = [e for e in events if e.get("ph") == "X"]
+    if not spans:
+        return []
+    stats: Dict[Tuple[str, str], Dict[str, float]] = {}
+    role_wall: Dict[str, Tuple[int, int]] = {}
+    for e in spans:
+        role = (e.get("args") or {}).get("role", "?")
+        start, end = int(e["ts"]), int(e["ts"]) + int(e.get("dur", 0))
+        lo, hi = role_wall.get(role, (start, end))
+        role_wall[role] = (min(lo, start), max(hi, end))
+        key = (role, str(e["name"]))
+        s = stats.setdefault(key, {"count": 0, "total_us": 0})
+        s["count"] += 1
+        s["total_us"] += int(e.get("dur", 0))
+    rows = []
+    for (role, phase), s in sorted(stats.items(), key=lambda kv: (kv[0][0], -kv[1]["total_us"])):
+        lo, hi = role_wall[role]
+        wall = max(1, hi - lo)
+        rows.append(
+            {
+                "role": role,
+                "phase": phase,
+                "count": int(s["count"]),
+                "total_ms": round(s["total_us"] / 1e3, 3),
+                "mean_ms": round(s["total_us"] / s["count"] / 1e3, 3),
+                "share_pct": round(100.0 * s["total_us"] / wall, 2),
+            }
+        )
+    return rows
+
+
+def format_phase_table(rows: List[Dict[str, Any]]) -> str:
+    if not rows:
+        return "no span events found"
+    header = f"{'role':<10s} {'phase':<16s} {'count':>7s} {'total ms':>12s} {'mean ms':>10s} {'share':>7s}"
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append(
+            f"{r['role']:<10s} {r['phase']:<16s} {r['count']:>7d} "
+            f"{r['total_ms']:>12.3f} {r['mean_ms']:>10.3f} {r['share_pct']:>6.1f}%"
+        )
+    return "\n".join(lines)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("paths", nargs="+", help="trace files and/or run dirs")
+    parser.add_argument("--out", metavar="MERGED", help="write the merged Chrome trace to MERGED")
+    parser.add_argument("--json", action="store_true", help="print the per-phase table as JSON")
+    args = parser.parse_args()
+
+    files = collect_trace_files(args.paths)
+    if not files:
+        print(f"error: no trace files found under {args.paths}", file=sys.stderr)
+        return 2
+    merged, sources = merge_traces(files)
+    rows = phase_table(merged)
+
+    if args.json:
+        print(json.dumps({"sources": sources, "phases": rows}, indent=2))
+    else:
+        for src in sources:
+            print(
+                f"source: {src['path']}  role={src['role']} rank={src['rank']} "
+                f"({src['n_events']} events)"
+            )
+        print()
+        print(format_phase_table(rows))
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fp:
+            json.dump([{k: v for k, v in e.items() if k != "abs_us"} for e in merged], fp)
+        print(f"\nwrote merged trace ({len(merged)} events) to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
