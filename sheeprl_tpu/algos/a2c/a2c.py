@@ -168,8 +168,13 @@ def main(runtime, cfg):
     # signature watch on the rollout policy (no shape-change injection here:
     # A2C's update consumes the whole batch, padding would alter the gradient)
     train_step = diag.instrument(
-        "train_step", make_train_step(agent, optimizer, cfg, runtime.mesh), kind="train"
+        "train_step",
+        make_train_step(agent, optimizer, cfg, runtime.mesh),
+        kind="train",
+        donate_argnums=(0, 1),  # params, opt_state — audited at first dispatch
     )
+    diag.register_footprint("params", params)
+    diag.register_footprint("opt_state", opt_state)
 
     @jax.jit
     def policy_step(params, obs, key):
@@ -194,6 +199,7 @@ def main(runtime, cfg):
         memmap_dir=os.path.join(log_dir, "memmap_buffer"),
         obs_keys=obs_keys,
     )
+    diag.track_buffer("replay", rb)
 
     start_iter = (state["iter_num"] if state else 0) + 1
     policy_step_count = state["policy_step"] if state else 0

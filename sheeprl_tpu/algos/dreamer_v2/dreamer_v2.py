@@ -39,7 +39,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import DeviceMetricsDrain, MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, get_diagnostics, save_configs
 
 METRIC_ORDER = [
     "Loss/world_model_loss",
@@ -285,6 +285,7 @@ def main(runtime, cfg):
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
     aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
     if cfg.metric.log_level == 0:
         aggregator.disabled = True
@@ -351,16 +352,25 @@ def main(runtime, cfg):
         params = jax.device_put(params, replicated_sharding(runtime.mesh))
         opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
 
-    train_step = make_train_step(
-        world_model_def,
-        actor_def,
-        critic_def,
-        optimizers,
-        cfg,
-        actions_dim,
-        is_continuous,
-        mesh=runtime.mesh if world_size > 1 else None,
+    # telemetry + memory instrumentation (watchdog, MFU FLOPs, transfer
+    # guard, donation audit, OOM forensics) — see tools/check_instrumentation.py
+    train_step = diag.instrument(
+        "train_step",
+        make_train_step(
+            world_model_def,
+            actor_def,
+            critic_def,
+            optimizers,
+            cfg,
+            actions_dim,
+            is_continuous,
+            mesh=runtime.mesh if world_size > 1 else None,
+        ),
+        kind="train",
+        donate_argnums=(0, 1),
     )
+    diag.register_footprint("params", params)
+    diag.register_footprint("opt_state", opt_states)
 
     # ---- buffer: sequential or episode (reference dreamer_v2.py:496-517) --
     buffer_type = cfg.buffer.type.lower() if cfg.buffer.get("type") else "sequential"

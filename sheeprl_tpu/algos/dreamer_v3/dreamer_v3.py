@@ -542,7 +542,11 @@ def _dreamer_main(
             mesh=runtime.mesh if world_size > 1 else None,
         ),
         kind="train",
+        donate_argnums=(0, 1, 2),  # params, opt_states, moments — audited at first dispatch
     )
+    diag.register_footprint("params", params)
+    diag.register_footprint("opt_state", opt_states)
+    diag.register_footprint("moments", moments_state)
 
     buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 2
     # HBM-resident replay when buffer.device=True: frames never leave the
@@ -551,6 +555,7 @@ def _dreamer_main(
     rb, use_device_buffer = make_dreamer_replay_buffer(
         cfg, world_size, num_envs, obs_keys, log_dir, buffer_size, mesh=runtime.mesh
     )
+    diag.track_buffer("replay", rb)
     buffer_state = state
     if buffer_state is None and cfg.buffer.get("load_from_exploration") and agent_state:
         # P2E finetuning may continue on the exploration replay buffer

@@ -262,7 +262,10 @@ def main(runtime, cfg):
         "train_step",
         make_train_step(agent, optimizer, cfg, runtime.mesh, num_minibatches, batch_size),
         kind="train",
+        donate_argnums=(0, 1),  # params, opt_state — audited at first dispatch
     )
+    diag.register_footprint("params", params)
+    diag.register_footprint("opt_state", opt_state)
 
     # jitted rollout policy + value bootstrap
     @jax.jit
@@ -298,6 +301,7 @@ def main(runtime, cfg):
         memmap_dir=os.path.join(log_dir, "memmap_buffer"),
         obs_keys=obs_keys,
     )
+    diag.track_buffer("replay", rb)
 
     # ---- counters (reference ppo.py:217-263) -----------------------------
     start_iter = (state["iter_num"] if state else 0) + 1

@@ -140,7 +140,11 @@ def main(runtime, cfg):
         "train_step",
         make_train_step(agent, optimizer, cfg, trainer_mesh, num_minibatches, batch_size),
         kind="train",
+        donate_argnums=(0, 1),  # trainer params, opt_state — audited at first dispatch
     )
+    diag.register_footprint("params", trainer_params)
+    diag.register_footprint("opt_state", opt_state)
+    diag.register_footprint("player_params", player_params)
 
     @jax.jit
     def _policy_step(params, obs, key):
@@ -169,6 +173,7 @@ def main(runtime, cfg):
         memmap_dir=os.path.join(log_dir, "memmap_buffer"),
         obs_keys=obs_keys,
     )
+    diag.track_buffer("replay", rb)
 
     start_iter = (state["iter_num"] if state else 0) + 1
     policy_step_count = state["policy_step"] if state else 0

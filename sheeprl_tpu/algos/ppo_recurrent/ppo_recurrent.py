@@ -40,7 +40,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import get_diagnostics, polynomial_decay, save_configs
 
 
 def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, seq_batch: int):
@@ -150,6 +150,7 @@ def main(runtime, cfg):
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
     aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
     if cfg.metric.log_level == 0:
         aggregator.disabled = True
@@ -201,7 +202,15 @@ def main(runtime, cfg):
             state["opt_state"],
         )
 
-    train_step = make_train_step(agent, optimizer, cfg, runtime.mesh, num_minibatches, seq_batch)
+    # telemetry + memory instrumentation — see tools/check_instrumentation.py
+    train_step = diag.instrument(
+        "train_step",
+        make_train_step(agent, optimizer, cfg, runtime.mesh, num_minibatches, seq_batch),
+        kind="train",
+        donate_argnums=(0, 1),
+    )
+    diag.register_footprint("params", params)
+    diag.register_footprint("opt_state", opt_state)
 
     hidden = cfg.algo.rnn.lstm.hidden_size
 

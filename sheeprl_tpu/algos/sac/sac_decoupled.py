@@ -109,7 +109,11 @@ def main(runtime, cfg):
         "train_step",
         make_train_step(actor_def, critic_def, optimizers, cfg, trainer_mesh, target_entropy),
         kind="train",
+        donate_argnums=(0, 1),  # params, opt_states — audited at first dispatch
     )
+    diag.register_footprint("params", params)
+    diag.register_footprint("opt_state", opt_states)
+    diag.register_footprint("player_params", player_actor_params)
 
     @jax.jit
     def _policy_step(actor_params, obs, key):
@@ -128,6 +132,7 @@ def main(runtime, cfg):
         memmap_dir=os.path.join(log_dir, "memmap_buffer"),
         obs_keys=("observations",),
     )
+    diag.track_buffer("replay", rb)
     if state and "rb" in state and state["rb"] is not None:
         rb.load_state_dict(state["rb"])
 

@@ -39,7 +39,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, get_diagnostics, save_configs
 
 
 def make_train_step(encoder_def, decoder_def, actor_def, critic_def, optimizers, cfg, target_entropy, mesh=None):
@@ -237,6 +237,7 @@ def main(runtime, cfg):
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
     aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
     if cfg.metric.log_level == 0:
         aggregator.disabled = True
@@ -279,16 +280,24 @@ def main(runtime, cfg):
             state["opt_states"],
         )
 
-    train_step = make_train_step(
-        encoder_def,
-        decoder_def,
-        actor_def,
-        critic_def,
-        optimizers,
-        cfg,
-        target_entropy,
-        mesh=runtime.mesh if world_size > 1 else None,
+    # telemetry + memory instrumentation — see tools/check_instrumentation.py
+    train_step = diag.instrument(
+        "train_step",
+        make_train_step(
+            encoder_def,
+            decoder_def,
+            actor_def,
+            critic_def,
+            optimizers,
+            cfg,
+            target_entropy,
+            mesh=runtime.mesh if world_size > 1 else None,
+        ),
+        kind="train",
+        donate_argnums=(0, 1),
     )
+    diag.register_footprint("params", params)
+    diag.register_footprint("opt_state", opt_states)
 
     @jax.jit
     def policy_step(params, obs, key):

@@ -270,6 +270,21 @@ class ReplayBuffer:
         else:
             self._buf[key] = np.copy(value.array if isinstance(value, MemmapArray) else value)
 
+    # -- footprint (diagnostics memory telemetry) -----------------------------
+    def footprint(self) -> Dict[str, int]:
+        """Allocated storage bytes by residence: memmap-backed keys count as
+        ``disk_bytes`` (the OS pages them; they do not pin RAM), plain numpy
+        keys as ``host_bytes``.  Journaled per metric interval when the loop
+        registered the buffer with ``diag.track_buffer``."""
+        host = 0
+        disk = 0
+        for v in self._buf.values():
+            if isinstance(v, MemmapArray):
+                disk += v.nbytes
+            else:
+                host += int(v.nbytes)
+        return {"host_bytes": host, "disk_bytes": disk}
+
     # -- checkpointing --------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
         return {
@@ -496,6 +511,13 @@ class EnvIndependentReplayBuffer:
             batch_size=batch_size, sample_next_obs=sample_next_obs, clone=clone, n_samples=n_samples, **kwargs
         )
         return to_device(samples, device=device, dtype=dtype)
+
+    def footprint(self) -> Dict[str, int]:
+        out = {"host_bytes": 0, "disk_bytes": 0}
+        for b in self._buf:
+            for kind, size in b.footprint().items():
+                out[kind] = out.get(kind, 0) + size
+        return out
 
     def state_dict(self) -> Dict[str, Any]:
         return {"buffers": [b.state_dict() for b in self._buf]}
@@ -763,6 +785,22 @@ class EpisodeBuffer:
     ) -> Dict[str, Any]:
         samples = self.sample(batch_size, sample_next_obs, n_samples, clone, sequence_length)
         return to_device(samples, device=device, dtype=dtype)
+
+    def footprint(self) -> Dict[str, int]:
+        """Stored episodes by residence + the still-open per-env episode
+        chunks (always host RAM)."""
+        host = 0
+        disk = 0
+        for ep in self._buf:
+            for v in ep.values():
+                if isinstance(v, MemmapArray):
+                    disk += v.nbytes
+                else:
+                    host += int(np.asarray(v).nbytes)
+        for chunks in self._open_episodes:
+            for chunk in chunks:
+                host += sum(int(np.asarray(v).nbytes) for v in chunk.values())
+        return {"host_bytes": host, "disk_bytes": disk}
 
     def state_dict(self) -> Dict[str, Any]:
         return {

@@ -306,6 +306,13 @@ class DeviceSequentialReplayBuffer:
                 )
         return out
 
+    # -- footprint (diagnostics memory telemetry) ------------------------------
+    def footprint(self) -> Dict[str, int]:
+        """HBM-resident storage bytes (``device_bytes`` is the GLOBAL total;
+        env-sharded storage splits it evenly across the mesh's devices)."""
+        total = sum(int(v.nbytes) for v in self._buf.values())
+        return {"device_bytes": total}
+
     # -- checkpointing ---------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
         # np.asarray over a jax.Array is a read-only view; copy so checkpoint

@@ -77,6 +77,15 @@ class MemmapArray:
         return self._mode
 
     @property
+    def nbytes(self) -> int:
+        """Bytes of the backing file (shape x itemsize — what the buffer
+        costs on disk; the OS pages it in and out of RAM on demand)."""
+        size = self._dtype.itemsize
+        for dim in self._shape:
+            size *= int(dim)
+        return size
+
+    @property
     def has_ownership(self) -> bool:
         return self._has_ownership
 

@@ -1,6 +1,7 @@
 """Run-health & observability subsystem.
 
-Four pillars behind one facade (ISSUE 1 tentpole + ISSUE 3 telemetry layer):
+Five pillars behind one facade (ISSUE 1 tentpole + ISSUE 3 telemetry layer +
+ISSUE 4 memory layer):
 
 * :mod:`~sheeprl_tpu.diagnostics.journal` — crash-safe JSONL run journal
   (write-ahead metric/event log; makes TensorBoard archaeology and the
@@ -16,7 +17,13 @@ Four pillars behind one facade (ISSUE 1 tentpole + ISSUE 3 telemetry layer):
   recompilation watchdog over the instrumented jitted steps, MFU/goodput
   accounting from compiled-step ``cost_analysis()`` FLOPs, phase-level
   wall-clock attribution, and (opt-in) a live rank-0 ``/metrics`` +
-  ``/healthz`` HTTP endpoint (:mod:`~sheeprl_tpu.diagnostics.metrics_server`).
+  ``/healthz`` HTTP endpoint (:mod:`~sheeprl_tpu.diagnostics.metrics_server`);
+* :mod:`~sheeprl_tpu.diagnostics.memory` — memory & data-movement telemetry
+  (ISSUE 4): per-interval HBM gauges + a static footprint breakdown, the
+  ``diagnostics.transfers`` host-transfer guard around the instrumented
+  dispatches, a first-dispatch donation/sharding audit, and OOM forensics
+  journaled before a ``RESOURCE_EXHAUSTED`` takes the process down
+  (``tools/memory_report.py`` renders the tables).
 
 The facade is constructed once in ``cli.run_algorithm`` from the
 ``configs/diagnostics/`` group and attached to the :class:`Runtime`; training
@@ -35,6 +42,7 @@ from contextlib import contextmanager, nullcontext
 from typing import Any, Dict, Mapping, Optional
 
 from sheeprl_tpu.diagnostics.journal import JOURNAL_NAME, RunJournal, find_journal, iter_journal, read_journal
+from sheeprl_tpu.diagnostics.memory import MEMORY_EVENTS, MemoryMonitor, tree_bytes
 from sheeprl_tpu.diagnostics.sentinel import (
     DivergenceDetector,
     SentinelHalt,
@@ -49,6 +57,8 @@ __all__ = [
     "Diagnostics",
     "DivergenceDetector",
     "JOURNAL_NAME",
+    "MEMORY_EVENTS",
+    "MemoryMonitor",
     "NullTracer",
     "PhaseTracer",
     "RunJournal",
@@ -63,6 +73,7 @@ __all__ = [
     "iter_journal",
     "read_journal",
     "sentinel_spec",
+    "tree_bytes",
 ]
 
 
@@ -116,6 +127,27 @@ class Diagnostics:
             telemetry = Telemetry(cfg or {})
             if telemetry.enabled:
                 self.telemetry = telemetry
+        self.memory: Optional[MemoryMonitor] = None
+        if self.enabled:
+            memory = MemoryMonitor(cfg or {})
+            if memory.enabled:
+                self.memory = memory
+                if self.telemetry is not None:
+                    # instrumented dispatches route through the monitor's
+                    # guarded scope (transfer guard / audits / OOM forensics)
+                    self.telemetry._memory = memory
+                elif memory.transfer_mode != "off" or memory._inject_transfer_iter is not None or memory._inject_oom_iter is not None:
+                    # the guard/audits/forensics live at the instrumented
+                    # dispatch boundary, which telemetry provides — a config
+                    # that asks for enforcement without it must not be
+                    # silently inert
+                    warnings.warn(
+                        f"diagnostics.transfers={memory.transfer_mode!r} (or a memory fault injection) "
+                        "is set but diagnostics.telemetry.enabled=False: the transfer guard, "
+                        "donation audit and OOM forensics attach to instrumented dispatches and "
+                        "will NOT run. Only the passive Telemetry/hbm_* gauges remain active.",
+                        RuntimeWarning,
+                    )
         self.journal: Optional[RunJournal] = None
         self.tracer = NullTracer()
         self.metrics_server = None
@@ -173,6 +205,10 @@ class Diagnostics:
                 run_id=self.run_id,
                 sentinel_policy=self.sentinel.policy if self.sentinel.enabled else None,
             )
+        if self.memory is not None:
+            # opened on every rank: the transfer guard must protect every
+            # process; journal writes no-op off rank 0 (journal is None there)
+            self.memory.open(self._journal_event, self._journal_sync)
         if self.telemetry is not None:
             self.telemetry.open(
                 self._journal_event,
@@ -208,6 +244,14 @@ class Diagnostics:
 
     def _server_snapshot(self) -> Dict[str, Any]:
         snap = self.telemetry.snapshot() if self.telemetry is not None else {}
+        if self.memory is not None:
+            mem = self.memory.snapshot()
+            snap.setdefault("gauges", {}).update(mem["gauges"])
+            snap.setdefault("counters", {}).update(mem["counters"])
+            info = snap.setdefault("info", {})
+            for k, v in mem["info"].items():
+                if v is not None:
+                    info.setdefault(k, v)
         if self.journal is not None and self.journal.last_write_t is not None:
             import time
 
@@ -217,6 +261,12 @@ class Diagnostics:
     def _journal_event(self, event: str, **fields: Any) -> None:
         if self.journal is not None:
             self.journal.write(event, **fields)
+
+    def _journal_sync(self) -> None:
+        """Force journal bytes to disk NOW (OOM forensics: the record must
+        survive the process dying right after it is written)."""
+        if self.journal is not None:
+            self.journal.sync()
 
     def close(self, status: str = "completed") -> None:
         if self._closed:
@@ -229,6 +279,8 @@ class Diagnostics:
             if self.journal is not None:
                 self.journal.write("telemetry_summary", **self.telemetry.summary())
             self.telemetry.close()
+        if self.memory is not None and self.journal is not None:
+            self.journal.write("memory_summary", **self.memory.summary())
         if self.journal is not None:
             self.journal.write("run_end", status=status)
             self.journal.close()
@@ -257,25 +309,43 @@ class Diagnostics:
                 self.telemetry.span_exit(token)
 
     # -- telemetry hooks ---------------------------------------------------
-    def instrument(self, name: str, fn, kind: str = "train"):
+    def instrument(self, name: str, fn, kind: str = "train", donate_argnums=()):
         """Wrap a jitted step for the recompile watchdog + FLOPs accounting
         (``kind="train"``) or signature-watch only (``kind="rollout"``).
-        Identity when telemetry is disabled."""
+        ``donate_argnums`` declares which arguments the wrapped jit donates —
+        the memory monitor verifies the donation actually happened at first
+        dispatch.  Identity when telemetry is disabled."""
         if self.telemetry is None:
             return fn
-        return self.telemetry.instrument(name, fn, kind=kind)
+        return self.telemetry.instrument(name, fn, kind=kind, donate_argnums=donate_argnums)
 
     def augment_metrics(self, step: Optional[int], metrics: Mapping[str, Any]) -> Mapping[str, Any]:
-        """Merge the interval's ``Telemetry/*`` gauges into an aggregated
-        metrics dict (called by the logger proxy before the backend logs)."""
-        if self.telemetry is None:
-            return metrics
-        extra = self.telemetry.interval_metrics(step)
+        """Merge the interval's ``Telemetry/*`` gauges (compute + memory) into
+        an aggregated metrics dict (called by the logger proxy before the
+        backend logs)."""
+        extra: Dict[str, Any] = {}
+        if self.telemetry is not None:
+            extra.update(self.telemetry.interval_metrics(step))
+        if self.memory is not None and self._rank_zero and self.log_dir is not None:
+            extra.update(self.memory.interval_metrics())
         if not extra:
             return metrics
         merged = dict(metrics)
         merged.update(extra)
         return merged
+
+    # -- memory hooks ------------------------------------------------------
+    def register_footprint(self, name: str, tree_or_bytes: Any) -> None:
+        """Record a static component's byte size (params / optimizer state /
+        ...) for the ``memory_breakdown`` event.  No-op when disabled."""
+        if self.memory is not None:
+            self.memory.register_footprint(name, tree_or_bytes)
+
+    def track_buffer(self, name: str, buffer: Any) -> None:
+        """Track a replay buffer's live footprint per metric interval
+        (host RAM, memmap on-disk, or HBM-resident bytes)."""
+        if self.memory is not None:
+            self.memory.track_buffer(name, buffer)
 
     # -- journal hooks -----------------------------------------------------
     def log_metrics(self, step: Optional[int], metrics: Mapping[str, Any]) -> None:

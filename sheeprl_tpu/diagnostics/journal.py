@@ -17,7 +17,11 @@ Writer protocol (one event per line):
 Event types emitted by the :class:`~sheeprl_tpu.diagnostics.Diagnostics`
 facade: ``run_start`` (config hash + run identity), ``metrics`` (aggregated
 metric dict at a log boundary, keyed by the policy-step counter),
-``checkpoint``, ``divergence`` (sentinel / detector findings) and ``run_end``.
+``checkpoint``, ``divergence`` (sentinel / detector findings), the telemetry
+events (``recompile`` / ``recompile_storm`` / ``telemetry_cost`` /
+``telemetry_fallback`` / ``metrics_server`` / ``telemetry_summary``), the
+memory events (``memory_breakdown`` / ``sharding_audit`` / ``donation_miss``
+/ ``host_transfer`` / ``oom`` / ``memory_summary``) and ``run_end``.
 Rank gating lives in the facade: under ``jax.distributed`` only the global
 rank-0 host owns a writer.
 """
@@ -102,6 +106,18 @@ class RunJournal:
                 os.fsync(self._fp.fileno())
             except OSError:  # pragma: no cover - exotic filesystems
                 pass
+
+    def sync(self) -> None:
+        """Force buffered events to disk regardless of the fsync cadence —
+        the OOM-forensics path calls this so the post-mortem record survives
+        the process dying immediately afterwards."""
+        if self._closed:
+            return
+        try:
+            self._fp.flush()
+            os.fsync(self._fp.fileno())
+        except (OSError, ValueError):  # pragma: no cover
+            pass
 
     def close(self) -> None:
         if self._closed:

@@ -137,6 +137,19 @@ def format_summary(summary: Dict[str, Any]) -> str:
 # ---------------------------------------------------------------------------
 # live formatting (shared by journal_report --follow and run_monitor)
 
+
+def format_bytes(n: Any) -> str:
+    """Human bytes (binary units) — '—' for missing values."""
+    if not isinstance(n, (int, float)):
+        return "—"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024.0
+    return f"{n:.1f} TiB"  # pragma: no cover - loop always returns
+
+
 _TELEMETRY_COLUMNS = (
     ("Rewards/rew_avg", "rew", "{:.2f}"),
     ("Telemetry/sps", "sps", "{:.0f}"),
@@ -174,6 +187,13 @@ def format_event_line(event: Dict[str, Any]) -> str:
         phases = _phase_summary(metrics)
         if phases:
             parts.append(phases)
+        hbm = metrics.get("Telemetry/hbm_bytes_in_use")
+        if isinstance(hbm, (int, float)):
+            peak = metrics.get("Telemetry/hbm_peak_bytes")
+            hbm_s = format_bytes(hbm)
+            if isinstance(peak, (int, float)) and peak > 0:
+                hbm_s += f"/{format_bytes(peak)}"
+            parts.append(f"hbm {hbm_s}")
         recompiles = metrics.get("Telemetry/recompiles")
         if isinstance(recompiles, (int, float)) and recompiles > 0:
             parts.append(f"recompiles {recompiles:g}")
@@ -185,6 +205,29 @@ def format_event_line(event: Dict[str, Any]) -> str:
         return f"[{clock}] {kind:<12s} {payload.get('fn')} #{payload.get('count')}: {head}"
     if kind == "divergence":
         return f"[{clock}] {kind:<12s} step {payload.get('step')}: {payload.get('kind')}"
+    if kind == "memory_breakdown":
+        components = payload.get("components") or {}
+        total = sum(v for v in components.values() if isinstance(v, (int, float)))
+        return (
+            f"[{clock}] {kind:<12s} {len(components)} components, {format_bytes(total)} static"
+            f" (source {payload.get('source', '?')})"
+        )
+    if kind == "sharding_audit":
+        flagged = payload.get("flagged_replicated") or []
+        head = f"{payload.get('n_leaves')} leaves, {format_bytes(payload.get('total_bytes_per_device'))}/device"
+        if flagged:
+            head += f"  REPLICATED: {', '.join(str(f) for f in flagged[:3])}"
+        return f"[{clock}] {kind:<12s} {payload.get('fn')}: {head}"
+    if kind == "host_transfer":
+        what = "BLOCKED" if payload.get("blocked") else ("injected d2h" if payload.get("injected") else "detected")
+        return f"[{clock}] {kind:<12s} {payload.get('fn')} call #{payload.get('call')}: {what} (policy {payload.get('policy')})"
+    if kind == "donation_miss":
+        return (
+            f"[{clock}] {kind:<12s} {payload.get('fn')}: {payload.get('n_leaves')} leaves kept alive "
+            f"({format_bytes(payload.get('bytes'))} not donated)"
+        )
+    if kind == "oom":
+        return f"[{clock}] {kind:<12s} {payload.get('fn')} call #{payload.get('call')}: {str(payload.get('error', ''))[:80]}"
     detail = " ".join(f"{k}={v}" for k, v in payload.items() if not isinstance(v, (dict, list)))
     return f"[{clock}] {kind:<12s} {detail}".rstrip()
 
@@ -224,4 +267,104 @@ def status_block(events: List[Dict[str, Any]]) -> str:
     n_ckpt = sum(1 for e in events if e.get("event") == "checkpoint")
     lines.append(f"events  {len(events)} total · {len(metrics_events)} intervals · "
                  f"{n_ckpt} checkpoints · {n_rec} recompiles · {n_div} divergences")
+    lines.extend(memory_status_lines(events))
+    return "\n".join(lines)
+
+
+def memory_status_lines(events: List[Dict[str, Any]]) -> List[str]:
+    """The HBM / transfers panel (run_monitor + memory_report share it):
+    latest hbm in-use vs peak, buffer/host bytes, and the data-movement
+    counters.  Empty when the run journaled no memory telemetry."""
+    metrics_events = [e for e in events if e.get("event") == "metrics"]
+    last = (metrics_events[-1].get("metrics") or {}) if metrics_events else {}
+    lines: List[str] = []
+    hbm = last.get("Telemetry/hbm_bytes_in_use")
+    if isinstance(hbm, (int, float)):
+        breakdown = next((e for e in events if e.get("event") == "memory_breakdown"), None)
+        source = (breakdown or {}).get("source", "")
+        parts = [f"hbm {format_bytes(hbm)} in use"]
+        peak = last.get("Telemetry/hbm_peak_bytes")
+        if isinstance(peak, (int, float)) and peak > 0:
+            parts[0] += f" / {format_bytes(peak)} peak"
+        if source:
+            parts[0] += f" ({source})"
+        for key, label in (
+            ("Telemetry/replay_host_bytes", "replay host"),
+            ("Telemetry/replay_disk_bytes", "replay disk"),
+            ("Telemetry/replay_device_bytes", "replay HBM"),
+            ("Telemetry/host_rss_bytes", "rss"),
+        ):
+            value = last.get(key)
+            if isinstance(value, (int, float)) and value > 0:
+                parts.append(f"{label} {format_bytes(value)}")
+        lines.append("memory  " + " · ".join(parts))
+    n_xfer = sum(1 for e in events if e.get("event") == "host_transfer")
+    n_miss = sum(int(e.get("n_leaves", 1)) for e in events if e.get("event") == "donation_miss")
+    n_oom = sum(1 for e in events if e.get("event") == "oom")
+    audit = next((e for e in events if e.get("event") == "sharding_audit"), None)
+    n_flagged = len((audit or {}).get("flagged_replicated") or [])
+    if n_xfer or n_miss or n_oom or n_flagged:
+        lines.append(
+            f"moves   {n_xfer} host transfers · {n_miss} donation-miss leaves · "
+            f"{n_flagged} flagged replicated · {n_oom} ooms"
+        )
+    return lines
+
+
+def format_memory_breakdown(event: Dict[str, Any]) -> str:
+    """The ``memory_breakdown`` journal event as a footprint table."""
+    lines = ["static footprint breakdown" + (f" (source: {event.get('source', '?')})" if event.get("source") else "")]
+    components = event.get("components") or {}
+    total = 0
+    for name, size in sorted(components.items(), key=lambda kv: -(kv[1] if isinstance(kv[1], (int, float)) else 0)):
+        if not isinstance(size, (int, float)) or size <= 0:
+            continue
+        total += size
+        lines.append(f"  {name:<24s} {format_bytes(size):>12s}")
+    lines.append(f"  {'total (components)':<24s} {format_bytes(total):>12s}")
+    for fn, analysis in sorted((event.get("executables") or {}).items()):
+        lines.append(f"  executable {fn}:")
+        for key in ("argument_bytes", "output_bytes", "temp_bytes", "generated_code_bytes", "alias_bytes"):
+            if key in analysis:
+                lines.append(f"    {key.replace('_bytes', ''):<22s} {format_bytes(analysis[key]):>12s}")
+    for row in event.get("device_memory") or []:
+        lines.append(
+            f"  device {row.get('device')}: {format_bytes(row.get('bytes_in_use'))} in use"
+            + (f", {format_bytes(row.get('peak_bytes_in_use'))} peak" if row.get("peak_bytes_in_use") else "")
+        )
+    live = event.get("live_arrays")
+    if live:
+        lines.append(
+            f"  live jax arrays: {live.get('n_arrays')} arrays, {format_bytes(live.get('bytes_in_use'))}"
+            f" (largest {format_bytes(live.get('largest_alloc_bytes'))})"
+        )
+    if event.get("host_rss_bytes") is not None:
+        lines.append(f"  process RSS: {format_bytes(event['host_rss_bytes'])}")
+    return "\n".join(lines)
+
+
+def format_sharding_audit(event: Dict[str, Any]) -> str:
+    """The ``sharding_audit`` journal event as a per-leaf table (largest
+    per-device cost first; replicated leaves marked)."""
+    lines = [
+        "sharding audit ({fn}): {n} leaves, {total} total, {per_dev}/device".format(
+            fn=event.get("fn", "?"),
+            n=event.get("n_leaves", "?"),
+            total=format_bytes(event.get("total_bytes")),
+            per_dev=format_bytes(event.get("total_bytes_per_device")),
+        )
+    ]
+    flagged = set(event.get("flagged_replicated") or [])
+    for row in event.get("rows") or []:
+        mark = " REPLICATED!" if row.get("path") in flagged else (" repl" if row.get("replicated") else "")
+        lines.append(
+            "  {per_dev:>12s}/dev  {dtype:<10s} {shape:<18s} x{nd}  {path}{mark}".format(
+                per_dev=format_bytes(row.get("bytes_per_device")),
+                dtype=str(row.get("dtype", "?")),
+                shape=str(row.get("shape", "?")),
+                nd=row.get("n_devices", 1),
+                path=row.get("path", "?"),
+                mark=mark,
+            )
+        )
     return "\n".join(lines)

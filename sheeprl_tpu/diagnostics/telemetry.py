@@ -206,11 +206,21 @@ class _Instrumented:
     journaled, so telemetry can never take training down.
     """
 
-    def __init__(self, telemetry: "Telemetry", name: str, fn: Callable, kind: str):
+    def __init__(
+        self,
+        telemetry: "Telemetry",
+        name: str,
+        fn: Callable,
+        kind: str,
+        donate_argnums: Tuple[int, ...] = (),
+    ):
         self._telemetry = telemetry
         self._fn = fn
         self.name = name
         self.kind = kind
+        # what the call site DECLARED it donates — the memory monitor verifies
+        # the buffers were actually consumed at first dispatch
+        self.donate_argnums = tuple(donate_argnums or ())
         self._use_aot = kind == "train" and telemetry.cost_analysis_enabled
         self._signature: Optional[Tuple[str, Tuple]] = None
         self._seen: set = set()
@@ -239,8 +249,13 @@ class _Instrumented:
             if compiled is not None:
                 self._signature = sig
                 try:
-                    out = compiled(*args, **kwargs)
+                    out = self._invoke(compiled, args, kwargs)
                 except Exception as err:
+                    if getattr(err, "_sheeprl_diag_handled", False):
+                        # the memory monitor already journaled this (blocked
+                        # host transfer / OOM forensics): it is a run problem,
+                        # not an AOT-path problem — do NOT fall back
+                        raise
                     # sharding/committed-ness corner the AOT call rejects:
                     # permanently revert to the native dispatch path
                     self._use_aot = False
@@ -251,11 +266,11 @@ class _Instrumented:
                         stage="aot_dispatch",
                         error=repr(err)[:200],
                     )
-                    out = self._fn(*args, **kwargs)
+                    out = self._invoke(self._fn, args, kwargs, retry=True)
                 tele._record_call(self)
                 return out
         self._signature = sig
-        out = self._fn(*args, **kwargs)
+        out = self._invoke(self._fn, args, kwargs)
         if new_sig and self._cache_size_probe is not None:
             # compile-cache-size probe (the no-jax.monitoring fallback): a
             # grown cache confirms the signature change was a real compile —
@@ -270,6 +285,16 @@ class _Instrumented:
                 self._cache_size_probe = None
         tele._record_call(self)
         return out
+
+    def _invoke(self, fn: Callable, args: Tuple[Any, ...], kwargs: Mapping[str, Any], retry: bool = False):
+        """The actual dispatch, routed through the memory monitor's guarded
+        scope (transfer guard / audits / OOM forensics) when one is attached.
+        ``retry`` marks the AOT-fallback re-dispatch of the same logical step
+        (the monitor must not count it twice)."""
+        mem = self._telemetry._memory
+        if mem is None:
+            return fn(*args, **kwargs)
+        return mem.guarded_call(self, lambda: fn(*args, **kwargs), args, kwargs, count_call=not retry)
 
     def _aot_compile(self, sig, args, kwargs):
         tele = self._telemetry
@@ -287,6 +312,10 @@ class _Instrumented:
                     compile_s=round(compile_s, 3),
                 )
             self._compiled[sig] = compiled
+            if tele._memory is not None:
+                # the executable's memory_analysis (activation temps etc.)
+                # feeds the memory_breakdown event — zero extra compiles
+                tele._memory.note_executable(self.name, compiled)
             return compiled
         except Exception as err:
             self._use_aot = False
@@ -351,6 +380,9 @@ class Telemetry:
 
         self._precision = str((cfg.get("fabric") or {}).get("precision", "32-true")) if cfg else "32-true"
         self._clock = clock
+        # the facade attaches the MemoryMonitor here so instrumented
+        # dispatches pick up the transfer guard / audits / OOM forensics
+        self._memory = None
         self._lock = threading.Lock()
         self._journal_fn: Optional[Callable[..., None]] = None
         self._span_stack = threading.local()
@@ -416,10 +448,12 @@ class Telemetry:
             self._journal_fn(event, **fields)
 
     # -- instrumentation ---------------------------------------------------
-    def instrument(self, name: str, fn: Callable, kind: str = "train") -> Callable:
+    def instrument(
+        self, name: str, fn: Callable, kind: str = "train", donate_argnums: Tuple[int, ...] = ()
+    ) -> Callable:
         if not self.enabled:
             return fn
-        wrapped = _Instrumented(self, name, fn, kind)
+        wrapped = _Instrumented(self, name, fn, kind, donate_argnums=donate_argnums)
         self._instrumented[name] = wrapped
         return wrapped
 

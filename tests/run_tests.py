@@ -13,8 +13,12 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
   sharding-HLO checks, and the diagnostics suite
   (`tests/test_diagnostics/`: journal/sentinel/tracing plus
   `test_telemetry.py` — recompile watchdog, MFU/phase math, /metrics
-  endpoint, trace merge, and the telemetry CLI e2e).  ~8 min on one CPU
-  core.  Budget: 25 min.
+  endpoint, trace merge, the telemetry CLI e2e — and `test_memory.py` —
+  footprint math, transfer guard, donation audit, OOM forensics,
+  memory_report rendering).  The suite is preceded by the fast
+  `tools/check_instrumentation.py` AST lint (train/rollout steps must
+  dispatch through diag.instrument and declare donate_argnums).  ~8 min on
+  one CPU core.  Budget: 25 min.
 * **e2e** — `tests/test_algos/` drives every algorithm through the real CLI
   on dummy envs at 1 and 2 virtual devices.  Slow by nature (each test
   compiles a train step).  Budget: 40 min.
@@ -63,6 +67,18 @@ SUITES: dict[str, tuple[list[str], int]] = {
 
 def run_suite(name: str, fail_fast: bool) -> int:
     pytest_args, timeout_s = SUITES[name]
+    if name == "unit":
+        # fast AST-only pre-step: fail the suite immediately if a training
+        # loop dropped diag.instrument or donate_argnums (the observability
+        # wiring the diagnostics suite then tests behaviorally)
+        lint = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "check_instrumentation.py")],
+            cwd=REPO_ROOT,
+            timeout=120,
+        ).returncode
+        if lint != 0:
+            print("!! suite 'unit' aborted: tools/check_instrumentation.py failed", flush=True)
+            return lint
     cmd = [sys.executable, "-m", "pytest", *pytest_args] + (["-x"] if fail_fast else [])
     print(f"\n=== suite: {name}  (timeout {timeout_s // 60} min) ===\n{' '.join(cmd)}", flush=True)
     t0 = time.monotonic()

@@ -96,9 +96,9 @@ def test_facade_run_lifecycle_and_config_hash(tmp_path):
     diag.close("completed")
     events = read_journal(str(tmp_path / "journal.jsonl"))
     kinds = [e["event"] for e in events]
-    # telemetry (default-on since ISSUE 3) closes with a cumulative summary
-    # right before run_end
-    assert kinds == ["run_start", "metrics", "checkpoint", "telemetry_summary", "run_end"]
+    # telemetry (default-on since ISSUE 3) and memory (default-on since
+    # ISSUE 4) each close with a cumulative summary right before run_end
+    assert kinds == ["run_start", "metrics", "checkpoint", "telemetry_summary", "memory_summary", "run_end"]
     start = events[0]
     assert start["algo"] == "ppo" and start["env"] == "discrete_dummy"
     assert len(start["config_hash"]) == 16

@@ -1,0 +1,458 @@
+"""Memory & data-movement observability tests (ISSUE 4 acceptance).
+
+Fast, tier-1 eligible:
+
+* footprint math: ``tree_bytes`` on synthetic pytrees, replay-buffer
+  ``footprint()`` for host / memmap / episode storage;
+* the transfer guard end-to-end through the real CLI: ``transfers=log`` +
+  the injection hook journals exactly one ``host_transfer`` event (and the
+  run completes), ``transfers=disallow`` turns the injected implicit
+  transfer into an error journaled before the run dies;
+* donation audit: a deliberately un-donated (re-usable) buffer produces a
+  ``donation_miss`` with the offending leaf path;
+* OOM forensics: a simulated ``RESOURCE_EXHAUSTED`` leaves a readable,
+  fsync'd ``oom`` record carrying the final memory snapshot — with no
+  ``Diagnostics.close()`` (SIGKILL-style teardown) at the unit level, and
+  through the real CLI at the e2e level;
+* ``/metrics`` serves the ``sheeprl_hbm_*`` gauges and data-movement
+  counters; ``tools/memory_report.py`` + ``tools/run_monitor.py`` render the
+  footprint/sharding tables and the HBM panel;
+* ``tools/check_instrumentation.py`` passes on the repo and catches a loop
+  that drops ``diag.instrument`` / ``donate_argnums``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EpisodeBuffer, ReplayBuffer
+from sheeprl_tpu.data.memmap import MemmapArray
+from sheeprl_tpu.diagnostics import build_diagnostics, read_journal
+from sheeprl_tpu.diagnostics.memory import (
+    MemoryMonitor,
+    donation_misses,
+    live_array_bytes,
+    normalize_transfer_mode,
+    sharding_table,
+    tree_bytes,
+)
+from sheeprl_tpu.diagnostics.metrics_server import MetricsServer, render_prometheus
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+
+def _diag_cfg(**diagnostics):
+    cfg = {
+        "enabled": True,
+        "journal": {"enabled": True},
+        "sentinel": {"enabled": False},
+        "trace": {"enabled": False},
+        "telemetry": {"enabled": True},
+    }
+    cfg.update(diagnostics)
+    return {
+        "diagnostics": cfg,
+        "fabric": {"precision": "32-true"},
+        "algo": {"name": "ppo"},
+        "env": {"id": "discrete_dummy"},
+        "seed": 0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# footprint math
+
+
+def test_tree_bytes_on_synthetic_trees():
+    import jax.numpy as jnp
+
+    tree = {
+        "a": np.zeros((4, 8), np.float32),  # 128 B
+        "b": [jnp.ones((16,), jnp.float32), jnp.ones((2, 2), jnp.int32)],  # 64 + 16
+        "scalars": (1, 2.5, None, "x"),  # non-arrays contribute 0
+    }
+    assert tree_bytes(tree) == 128 + 64 + 16
+    assert tree_bytes({}) == 0
+
+
+def test_replay_buffer_footprint_host_vs_memmap(tmp_path):
+    rb = ReplayBuffer(buffer_size=10, n_envs=2, obs_keys=("obs",))
+    rb.add({"obs": np.zeros((3, 2, 4), np.float32)})
+    fp = rb.footprint()
+    # storage is allocated for the FULL ring: 10*2*4 floats
+    assert fp == {"host_bytes": 10 * 2 * 4 * 4, "disk_bytes": 0}
+
+    mm = ReplayBuffer(buffer_size=10, n_envs=2, obs_keys=("obs",), memmap=True, memmap_dir=tmp_path / "mm")
+    mm.add({"obs": np.zeros((3, 2, 4), np.float32)})
+    fp = mm.footprint()
+    assert fp == {"host_bytes": 0, "disk_bytes": 10 * 2 * 4 * 4}
+    # the MemmapArray's own accounting matches the backing file
+    arr = mm["obs"]
+    assert isinstance(arr, MemmapArray)
+    assert arr.nbytes == os.path.getsize(arr.filename) == 10 * 2 * 4 * 4
+
+
+def test_episode_buffer_footprint_counts_open_episodes():
+    eb = EpisodeBuffer(buffer_size=32, minimum_episode_length=2, n_envs=1, obs_keys=("obs",))
+    data = {
+        "obs": np.zeros((4, 1, 2), np.float32),
+        "terminated": np.array([[0], [0], [0], [1]], np.float32).reshape(4, 1, 1),
+        "truncated": np.zeros((4, 1, 1), np.float32),
+    }
+    eb.add(data)  # closes one 4-step episode
+    closed = eb.footprint()
+    assert closed["disk_bytes"] == 0 and closed["host_bytes"] > 0
+    open_data = {
+        "obs": np.zeros((3, 1, 2), np.float32),
+        "terminated": np.zeros((3, 1, 1), np.float32),
+        "truncated": np.zeros((3, 1, 1), np.float32),
+    }
+    eb.add(open_data)  # no done: stays an open chunk, still memory
+    assert eb.footprint()["host_bytes"] > closed["host_bytes"]
+
+
+def test_live_array_bytes_sees_new_arrays():
+    import jax.numpy as jnp
+
+    before = live_array_bytes()
+    keep = jnp.zeros((256, 256), jnp.float32)  # 256 KiB
+    after = live_array_bytes()
+    assert after["bytes_in_use"] >= before["bytes_in_use"] + keep.nbytes
+    assert after["largest_alloc_bytes"] >= keep.nbytes
+    del keep
+
+
+def test_normalize_transfer_mode_accepts_yaml_bool_spellings():
+    # YAML 1.1 resolves a bare `off` to False — both spellings must work
+    assert normalize_transfer_mode(None) == "off"
+    assert normalize_transfer_mode(False) == "off"
+    assert normalize_transfer_mode("off") == "off"
+    assert normalize_transfer_mode("log") == "log"
+    assert normalize_transfer_mode("disallow") == "disallow"
+    with pytest.raises(ValueError):
+        normalize_transfer_mode("everything")
+
+
+# ---------------------------------------------------------------------------
+# donation & sharding audits (unit level)
+
+
+def test_donation_miss_on_deliberately_reused_buffer(tmp_path):
+    """A jit WITHOUT donate_argnums behind an instrument call that declares
+    them = the args stay alive after dispatch = a journaled donation_miss
+    naming the leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    diag = build_diagnostics(_diag_cfg()).open(str(tmp_path))
+    fn = jax.jit(lambda p, x: (jax.tree_util.tree_map(lambda l: l * 0.9, p), x.sum()))  # no donation!
+    step = diag.instrument("train_step", fn, kind="train", donate_argnums=(0,))
+    params = {"w": jnp.ones((8, 8))}
+    new_params, _ = step(params, jnp.ones((4, 8)))
+    assert not params["w"].is_deleted()  # the buffer really was kept alive
+    diag.close()
+    events = read_journal(str(tmp_path / "journal.jsonl"))
+    (miss,) = [e for e in events if e["event"] == "donation_miss"]
+    assert miss["fn"] == "train_step" and miss["n_leaves"] == 1
+    assert "w" in miss["leaves"][0]["path"] and miss["leaves"][0]["reason"] == "not donated"
+    summary = next(e for e in events if e["event"] == "memory_summary")
+    assert summary["donation_miss_leaves"] == 1
+
+
+def test_donated_buffer_produces_no_miss(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    diag = build_diagnostics(_diag_cfg()).open(str(tmp_path))
+    fn = jax.jit(lambda p, x: (jax.tree_util.tree_map(lambda l: l * 0.9, p), x.sum()), donate_argnums=(0,))
+    step = diag.instrument("train_step", fn, kind="train", donate_argnums=(0,))
+    params, _ = step({"w": jnp.ones((8, 8))}, jnp.ones((4, 8)))
+    diag.close()
+    events = read_journal(str(tmp_path / "journal.jsonl"))
+    assert not [e for e in events if e["event"] == "donation_miss"]
+    # the sharding audit and breakdown still ran at first dispatch
+    (audit,) = [e for e in events if e["event"] == "sharding_audit"]
+    assert audit["n_leaves"] >= 2 and audit["rows"][0]["bytes_per_device"] > 0
+    (breakdown,) = [e for e in events if e["event"] == "memory_breakdown"]
+    assert breakdown["source"] in ("memory_stats", "live_arrays")
+
+
+def test_donation_misses_flags_host_arrays():
+    misses = donation_misses((np.zeros((4, 4), np.float32),), (0,))
+    assert misses and misses[0]["reason"] == "host array"
+
+
+def test_sharding_table_flags_replicated_on_virtual_mesh():
+    """On the 8-device virtual CPU platform a replicated array reports its
+    full bytes per device; a sharded one reports its shard."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, ("data",))
+    repl = jax.device_put(jnp.ones((64, 64), jnp.float32), NamedSharding(mesh, P()))
+    sharded = jax.device_put(jnp.ones((64, 64), jnp.float32), NamedSharding(mesh, P("data")))
+    table = sharding_table((repl, sharded), {}, top_n=10)
+    rows = {r["path"]: r for r in table["rows"]}
+    (repl_row,) = [r for r in rows.values() if r["replicated"]]
+    (shard_row,) = [r for r in rows.values() if not r["replicated"]]
+    assert repl_row["bytes_per_device"] == 64 * 64 * 4
+    assert shard_row["bytes_per_device"] == 64 * 64 * 4 // 8
+    monitor = MemoryMonitor({"diagnostics": {"memory": {"replicated_warn_bytes": 1024}}})
+    journaled = []
+    monitor.open(lambda event, **f: journaled.append((event, f)))
+
+    class Inst:
+        name, kind, donate_argnums = "train_step", "train", ()
+
+    monitor.guarded_call(Inst(), lambda: None, (repl, sharded), {})
+    ((event, fields),) = [(e, f) for e, f in journaled if e == "sharding_audit"]
+    assert fields["flagged_replicated"] == [repl_row["path"]]
+    # flagging happens BEFORE top_n truncation: a replicated leaf outranked
+    # by bigger sharded leaves must still be flagged even off the table
+    big_sharded = jax.device_put(jnp.ones((512, 64), jnp.float32), NamedSharding(mesh, P("data")))
+    small_repl = jax.device_put(jnp.ones((32, 32), jnp.float32), NamedSharding(mesh, P()))
+    truncated = sharding_table((big_sharded, small_repl), {}, top_n=1, replicated_warn_bytes=1024)
+    assert len(truncated["rows"]) == 1 and not truncated["rows"][0]["replicated"]
+    assert len(truncated["flagged_replicated"]) == 1  # flagged despite truncation
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics (unit level: SIGKILL-style teardown — no close())
+
+
+def test_oom_forensics_record_survives_without_close(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    diag = build_diagnostics(_diag_cfg(memory={"inject_oom_iter": 2})).open(str(tmp_path))
+    step = diag.instrument("train_step", jax.jit(lambda x: x * 2), kind="train")
+    diag.register_footprint("params", {"w": jnp.ones((64,))})
+    step(jnp.ones((4,)))
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        step(jnp.ones((4,)))
+    # NO diag.close(): emulate the process dying right after the raise — the
+    # record must already be fsync'd on disk
+    events = read_journal(str(tmp_path / "journal.jsonl"))
+    (oom,) = [e for e in events if e["event"] == "oom"]
+    assert oom["fn"] == "train_step" and oom["call"] == 2
+    assert "RESOURCE_EXHAUSTED" in oom["error"]
+    assert oom["components"]["params"] == 64 * 4
+    assert "live_arrays" in oom or "device_memory" in oom
+    assert events[-1]["event"] == "oom"  # nothing after it: kill-safe
+    diag.close()  # cleanup for the test process only
+
+
+# ---------------------------------------------------------------------------
+# /metrics endpoint gauges
+
+
+def test_metrics_endpoint_serves_hbm_gauges_and_movement_counters(tmp_path):
+    import jax.numpy as jnp
+
+    diag = build_diagnostics(_diag_cfg()).open(str(tmp_path))
+    keep = jnp.ones((128,), jnp.float32)
+    diag.memory.interval_metrics()  # close one accounting interval
+    server = MetricsServer(diag._server_snapshot, port=0)
+    host, port = server.start()
+    with urllib.request.urlopen(f"http://{host}:{port}/metrics") as resp:
+        body = resp.read().decode()
+    server.close()
+    diag.close()
+    assert "sheeprl_hbm_bytes_in_use " in body
+    assert "sheeprl_hbm_peak_bytes " in body
+    assert "sheeprl_host_transfers_total 0" in body
+    assert "sheeprl_donation_miss_leaves_total 0" in body
+    assert "sheeprl_oom_events_total 0" in body
+    value = float(
+        next(l for l in body.splitlines() if l.startswith("sheeprl_hbm_bytes_in_use ")).split()[1]
+    )
+    assert value >= keep.nbytes
+    # render path agrees with the snapshot (no drift between the two)
+    assert render_prometheus(diag._server_snapshot()) is not None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the real CLI (ISSUE 4 acceptance)
+
+PPO_TINY = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=1",
+    "metric.log_every=1",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.run_test=False",
+    "checkpoint.save_last=False",
+]
+
+
+def test_cli_ppo_full_journals_hbm_breakdown_and_injected_transfer(run_cli):
+    """The acceptance run: ``diagnostics=full`` + ``transfers=log`` + the
+    injection hook.  One tiny PPO run journals ``Telemetry/hbm_bytes_in_use``
+    each metric interval, a ``memory_breakdown``, exactly one ``host_transfer``
+    from the injected device→host sync — and completes normally.  The
+    memory_report / run_monitor panels render from the same journal."""
+    run_cli(
+        *PPO_TINY,
+        "algo.total_steps=48",
+        "diagnostics=full",
+        "diagnostics.transfers=log",
+        "diagnostics.memory.inject_transfer_iter=2",
+    )
+    (journal_path,) = sorted(Path("logs").rglob("journal.jsonl"))
+    events = read_journal(str(journal_path))
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+
+    # (1) hbm gauges ride EVERY metric interval
+    metrics_rows = [e["metrics"] for e in events if e["event"] == "metrics"]
+    assert len(metrics_rows) >= 2
+    for row in metrics_rows:
+        assert row["Telemetry/hbm_bytes_in_use"] > 0
+        assert row["Telemetry/hbm_peak_bytes"] >= row["Telemetry/hbm_bytes_in_use"] * 0.99
+        assert row["Telemetry/host_rss_bytes"] > 0
+        assert row["Telemetry/replay_host_bytes"] > 0  # tracked buffer, memmap off
+
+    # (2) one-shot static footprint breakdown with the AOT executable's
+    # memory_analysis (zero extra compiles) + component tree bytes
+    (breakdown,) = [e for e in events if e["event"] == "memory_breakdown"]
+    assert breakdown["components"]["params"] > 0
+    assert breakdown["components"]["opt_state"] > 0
+    assert breakdown["components"]["replay_host_bytes"] > 0
+    assert breakdown["executables"]["train_step"]["temp_bytes"] >= 0
+    assert breakdown["source"] == "live_arrays"  # CPU backend: no memory_stats
+
+    # (3) the injected fault produced EXACTLY one host_transfer, with
+    # provenance, and the run survived (policy log)
+    (transfer,) = [e for e in events if e["event"] == "host_transfer"]
+    assert transfer["fn"] == "train_step" and transfer["call"] == 2
+    assert transfer["injected"] is True and transfer["direction"] == "device_to_host"
+
+    # (4) first-dispatch sharding audit + closing memory summary
+    (audit,) = [e for e in events if e["event"] == "sharding_audit"]
+    assert audit["n_leaves"] > 0 and audit["flagged_replicated"] == []
+    (summary,) = [e for e in events if e["event"] == "memory_summary"]
+    assert summary["host_transfers"] == 1 and summary["oom_events"] == 0
+
+    # (5) donation works on this backend: no misses on the real train step
+    assert not [e for e in events if e["event"] == "donation_miss"]
+
+    # (6) the report tools render the journal (shared formatting)
+    report = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "memory_report.py"), str(journal_path)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert report.returncode == 0, report.stderr[-2000:]
+    assert "static footprint breakdown" in report.stdout
+    assert "sharding audit (train_step)" in report.stdout
+    assert "injected d2h" in report.stdout
+    assert "hbm timeline" in report.stdout
+    monitor = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "run_monitor.py"), str(journal_path)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert monitor.returncode == 0, monitor.stderr[-2000:]
+    assert "memory  hbm" in monitor.stdout and "in use" in monitor.stdout
+    assert "1 host transfers" in monitor.stdout
+
+
+def test_cli_ppo_disallow_blocks_injected_transfer(run_cli):
+    """``transfers=disallow``: the injected implicit host→device transfer is
+    rejected by the guard, journaled with provenance, and kills the run —
+    while the journal keeps the record (fsync'd before the re-raise)."""
+    with pytest.raises(Exception, match="[Dd]isallowed"):
+        run_cli(
+            *PPO_TINY,
+            "algo.total_steps=16",
+            "diagnostics.transfers=disallow",
+            "diagnostics.memory.inject_transfer_iter=1",
+        )
+    (journal_path,) = sorted(Path("logs").rglob("journal.jsonl"))
+    events = read_journal(str(journal_path))
+    (transfer,) = [e for e in events if e["event"] == "host_transfer"]
+    assert transfer["blocked"] is True and transfer["policy"] == "disallow"
+    assert transfer["fn"] == "train_step" and transfer["call"] == 1
+    # the CLI's finally-close recorded the abort
+    assert events[-1] == {**events[-1], "event": "run_end", "status": "aborted"}
+
+
+def test_cli_ppo_simulated_oom_leaves_readable_record(run_cli):
+    """A simulated RESOURCE_EXHAUSTED at the dispatch boundary journals the
+    final memory snapshot before the exception takes the run down."""
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        run_cli(
+            *PPO_TINY,
+            "algo.total_steps=16",
+            "diagnostics.memory.inject_oom_iter=1",
+        )
+    (journal_path,) = sorted(Path("logs").rglob("journal.jsonl"))
+    events = read_journal(str(journal_path))
+    (oom,) = [e for e in events if e["event"] == "oom"]
+    assert oom["fn"] == "train_step" and "RESOURCE_EXHAUSTED" in oom["error"]
+    assert oom["components"]["params"] > 0  # the snapshot names the components
+    assert "live_arrays" in oom or "device_memory" in oom
+    assert oom["host_rss_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# instrumentation lint
+
+
+def test_check_instrumentation_passes_on_repo():
+    result = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "check_instrumentation.py")],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_check_instrumentation_catches_dropped_wiring(tmp_path):
+    import check_instrumentation as lint
+
+    bad = tmp_path / "newalgo"
+    bad.mkdir()
+    (bad / "newalgo.py").write_text(
+        "import jax\n"
+        "def make_train_step(agent):\n"
+        "    def update(params, opt_state, data):\n"
+        "        return params, opt_state\n"
+        "    return jax.jit(update)\n"  # donation dropped
+        "def main(runtime, cfg):\n"
+        "    train_step = make_train_step(None)\n"  # not instrumented
+        "    diag = None\n"
+        "    policy = diag.instrument('train_step', None, kind='train')\n"  # no donate declared
+    )
+    errors = lint.run(str(tmp_path))
+    joined = "\n".join(errors)
+    assert "no (or an empty) donate_argnums" in joined
+    assert "not dispatched through diag.instrument" in joined
+    assert "does not declare" in joined
+    # flagship files are not under tmp_path: the lint must notice they vanished
+    assert any("flagship loop file not found" in e for e in errors)

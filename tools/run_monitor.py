@@ -10,9 +10,12 @@ Two sources, same view:
   across machines without filesystem access.
 
 Shows run identity and state, the latest metric interval (reward, SPS,
-TFLOP/s, MFU, phase breakdown), recompile/divergence counters and — with
-``--follow`` — streams every new journal row as a compact line
-(``tools/journal_report.py --follow`` shares this exact formatting).
+TFLOP/s, MFU, phase breakdown), an HBM/transfers panel (bytes in use vs
+peak, replay/RSS footprint, host-transfer + donation-miss + OOM counters)
+and recompile/divergence counters; with ``--follow`` it streams every new
+journal row as a compact line (``tools/journal_report.py --follow`` shares
+this exact formatting; ``tools/memory_report.py`` renders the full footprint
+and sharding tables).
 
 Usage:
     python tools/run_monitor.py logs/runs/ppo/CartPole-v1/<run>/
@@ -34,7 +37,7 @@ from typing import Any, Dict, Iterator, List, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from sheeprl_tpu.diagnostics.journal import find_journal  # noqa: E402
-from sheeprl_tpu.diagnostics.report import format_event_line, status_block  # noqa: E402
+from sheeprl_tpu.diagnostics.report import format_bytes, format_event_line, status_block  # noqa: E402
 
 _PROM_LINE = re.compile(r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?P<labels>\{[^}]*\})?\s+(?P<value>\S+)$")
 
@@ -143,12 +146,34 @@ def endpoint_status(url: str) -> str:
         parts.append(" ".join(f"{k}:{v:.0f}%" for k, v in phases))
     if parts:
         lines.append("latest  " + "  ".join(parts))
+    mem_parts = []
+    hbm = metrics.get("sheeprl_hbm_bytes_in_use")
+    if hbm is not None:
+        part = f"hbm {format_bytes(hbm)} in use"
+        peak = metrics.get("sheeprl_hbm_peak_bytes")
+        if peak:
+            part += f" / {format_bytes(peak)} peak"
+        mem_parts.append(part)
+    for key, label in (
+        ("sheeprl_replay_host_bytes", "replay host"),
+        ("sheeprl_replay_disk_bytes", "replay disk"),
+        ("sheeprl_replay_device_bytes", "replay HBM"),
+        ("sheeprl_host_rss_bytes", "rss"),
+    ):
+        value = metrics.get(key)
+        if value:
+            mem_parts.append(f"{label} {format_bytes(value)}")
+    if mem_parts:
+        lines.append("memory  " + " · ".join(mem_parts))
     counters = []
     for key, label in (
         ("sheeprl_recompiles_total", "recompiles"),
         ("sheeprl_recompile_storms_total", "storms"),
         ("sheeprl_sentinel_events_total", "sentinel events"),
         ("sheeprl_backend_compiles_total", "compiles"),
+        ("sheeprl_host_transfers_total", "host transfers"),
+        ("sheeprl_donation_miss_leaves_total", "donation-miss leaves"),
+        ("sheeprl_oom_events_total", "ooms"),
     ):
         value = metrics.get(key)
         if value is not None:
