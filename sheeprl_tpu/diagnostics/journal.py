@@ -14,14 +14,11 @@ Writer protocol (one event per line):
 
 ``{"t": <unix time>, "event": "<type>", ...}``
 
-Event types emitted by the :class:`~sheeprl_tpu.diagnostics.Diagnostics`
-facade: ``run_start`` (config hash + run identity), ``metrics`` (aggregated
-metric dict at a log boundary, keyed by the policy-step counter),
-``checkpoint``, ``divergence`` (sentinel / detector findings), the telemetry
-events (``recompile`` / ``recompile_storm`` / ``telemetry_cost`` /
-``telemetry_fallback`` / ``metrics_server`` / ``telemetry_summary``), the
-memory events (``memory_breakdown`` / ``sharding_audit`` / ``donation_miss``
-/ ``host_transfer`` / ``oom`` / ``memory_summary``) and ``run_end``.
+The event-kind vocabulary is declared centrally in
+:data:`sheeprl_tpu.diagnostics.schema.EVENT_KINDS` (one description per
+kind); the JRN pass of ``tools/sheeprl_lint.py`` statically verifies that
+every ``write("<kind>", ...)`` call site in the tree uses a registered kind
+and that the ``howto/diagnostics.md`` event table matches the registry.
 Rank gating lives in the facade: under ``jax.distributed`` only the global
 rank-0 host owns a writer.
 """

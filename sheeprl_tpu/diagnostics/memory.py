@@ -60,8 +60,9 @@ import threading
 from contextlib import nullcontext
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
-# journal event types this module emits
-MEMORY_EVENTS = ("memory_breakdown", "sharding_audit", "donation_miss", "host_transfer", "oom")
+# journal event types this module emits (declared centrally in the schema
+# registry; re-exported here for the existing import surface)
+from sheeprl_tpu.diagnostics.schema import MEMORY_EVENTS  # noqa: E402
 
 _TRANSFER_MODES = ("off", "log", "disallow")
 

@@ -20,6 +20,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Mapping, Optional, Tuple
 
+from sheeprl_tpu.diagnostics.schema import METRIC_PREFIX
+
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
@@ -50,7 +52,7 @@ def render_prometheus(snapshot: Mapping[str, Any]) -> str:
     lines = []
 
     def emit(name: str, mtype: str, value: Any, help_text: str = "", labels: Optional[Dict] = None):
-        full = f"sheeprl_{name}"
+        full = METRIC_PREFIX + name
         if help_text:
             lines.append(f"# HELP {full} {help_text}")
         lines.append(f"# TYPE {full} {mtype}")

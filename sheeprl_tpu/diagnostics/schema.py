@@ -1,0 +1,108 @@
+"""Central registry of the diagnostics subsystem's wire formats.
+
+Every journal event kind any module may write and every Prometheus metric
+name the ``/metrics`` endpoint may expose is declared HERE, once, with a
+one-line description.  Three consumers keep the registry honest:
+
+* the runtime — :mod:`~sheeprl_tpu.diagnostics.journal`,
+  :mod:`~sheeprl_tpu.diagnostics.memory` and
+  :mod:`~sheeprl_tpu.diagnostics.metrics_server` import their event/metric
+  vocabularies from this module instead of re-declaring them;
+* the static analyzer — the JRN pass of ``tools/sheeprl_lint.py`` parses this
+  file (AST only, no import) and fails when any ``journal.write("<kind>")``
+  call site in the tree uses a kind missing from :data:`EVENT_KINDS`, or when
+  a gauge/counter literal in the diagnostics package does not resolve to a
+  :data:`METRICS` entry prefixed ``sheeprl_``;
+* the docs — the event table in ``howto/diagnostics.md`` is verified against
+  :data:`EVENT_KINDS` (same JRN pass), so adding an event kind here without
+  documenting it is a lint failure, not silent drift.
+
+To add a journal event kind: add it to :data:`EVENT_KINDS`, emit it, and add
+a row to the ``howto/diagnostics.md`` table.  To add a ``/metrics`` name: add
+the full exported name (``sheeprl_*``) to :data:`METRICS`.  The lint tells
+you which of the three places you forgot.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+#: Exported Prometheus names all start with this (the ``emit`` helper in
+#: :mod:`~sheeprl_tpu.diagnostics.metrics_server` prefixes it).
+METRIC_PREFIX = "sheeprl_"
+
+#: Every journal event kind -> one-line description (the howto table's text).
+EVENT_KINDS: Dict[str, str] = {
+    "run_start": "config hash, algo/env/seed, run identity, sentinel policy",
+    "metrics": "every aggregated metric interval, keyed by the policy-step counter",
+    "checkpoint": "step + checkpoint path",
+    "divergence": "structured sentinel/detector findings",
+    "fault_injection": "a test-only fault fired (NaN poison, shape change, transfer/OOM drill)",
+    "recompile": "watchdog: a new dispatch signature, with the per-leaf shape/dtype diff",
+    "recompile_storm": "watchdog: recompile rate crossed the storm threshold",
+    "telemetry_cost": "compiled-step cost_analysis FLOPs for one instrumented signature",
+    "telemetry_fallback": "AOT compile/dispatch failed; the step reverted to native jit dispatch",
+    "metrics_server": "the /metrics endpoint address (or its bind failure)",
+    "telemetry_summary": "closing perf totals (recompiles, compile time, FLOPs, phase seconds)",
+    "memory_breakdown": "one-shot static footprint decomposition at first train dispatch",
+    "sharding_audit": "per-leaf bytes/sharding table of the first train dispatch",
+    "donation_miss": "declared donations whose buffers were still alive after dispatch",
+    "host_transfer": "a transfer-guard trip (device<->host sync) with provenance",
+    "oom": "RESOURCE_EXHAUSTED forensics: full memory snapshot, fsync'd before re-raise",
+    "memory_summary": "closing memory totals (peaks, guard trips, donation misses)",
+    "run_end": "completed / halted / aborted — absent after a kill",
+}
+
+#: Journal event kinds emitted by the memory monitor (handler routing in the
+#: facade and the ``tools/memory_report.py`` views key off this subset).
+MEMORY_EVENTS: Tuple[str, ...] = (
+    "memory_breakdown",
+    "sharding_audit",
+    "donation_miss",
+    "host_transfer",
+    "oom",
+)
+
+#: Every metric name the /metrics endpoint may export -> description.
+#: Names are the FULL exported spelling (``sheeprl_`` prefix included); the
+#: snapshot-dict keys that produce them are mapped through
+#: :func:`sheeprl_tpu.diagnostics.metrics_server._metric_name`.
+METRICS: Dict[str, str] = {
+    # fixed series emitted by metrics_server.render_prometheus
+    "sheeprl_up": "1 while the training process serves the endpoint",
+    "sheeprl_run_info": "run identity as labels (value is always 1)",
+    "sheeprl_policy_steps_total": "policy steps taken (env frames / action_repeat)",
+    "sheeprl_phase_seconds_total": "cumulative wall-clock per host phase (label: phase)",
+    "sheeprl_journal_lag_seconds": "seconds since the last journal write",
+    # telemetry counters (Telemetry.snapshot()["counters"])
+    "sheeprl_recompiles_total": "watchdog: new dispatch signatures seen",
+    "sheeprl_recompile_storms_total": "watchdog: storm threshold crossings",
+    "sheeprl_backend_compiles_total": "jax.monitoring backend compile events",
+    "sheeprl_compile_seconds_total": "cumulative backend compile wall-clock",
+    "sheeprl_sentinel_events_total": "journaled divergence/sentinel findings",
+    "sheeprl_train_flops_total": "cumulative FLOPs dispatched through kind=train steps",
+    # memory counters (MemoryMonitor.snapshot()["counters"])
+    "sheeprl_host_transfers_total": "transfer-guard trips journaled",
+    "sheeprl_donation_miss_leaves_total": "leaves that missed a declared donation",
+    "sheeprl_oom_events_total": "RESOURCE_EXHAUSTED events journaled",
+    # interval gauges (Telemetry/... keys, prefix-stripped and sanitized)
+    "sheeprl_mfu": "model FLOPs utilization vs the device-kind peak",
+    "sheeprl_tflops_per_sec": "achieved TFLOP/s over the last interval",
+    "sheeprl_sps": "policy steps per second over the last interval",
+    "sheeprl_recompiles": "recompiles within the last interval",
+    "sheeprl_compile_count": "backend compiles within the last interval",
+    "sheeprl_compile_time_s": "backend compile seconds within the last interval",
+    "sheeprl_phase_pct_train": "interval wall-clock share: train dispatch+fetch",
+    "sheeprl_phase_pct_env": "interval wall-clock share: env stepping",
+    "sheeprl_phase_pct_fetch": "interval wall-clock share: metric/buffer fetch",
+    "sheeprl_phase_pct_other": "interval wall-clock share: other instrumented spans",
+    "sheeprl_phase_pct_idle": "interval wall-clock share: un-instrumented host time",
+    # memory gauges (Telemetry/hbm_* etc., prefix-stripped)
+    "sheeprl_hbm_bytes_in_use": "per-device HBM bytes in use (max over devices)",
+    "sheeprl_hbm_peak_bytes": "per-device HBM peak bytes (max over devices)",
+    "sheeprl_hbm_largest_alloc_bytes": "largest single HBM allocation",
+    "sheeprl_host_rss_bytes": "host process resident set size",
+    "sheeprl_replay_host_bytes": "replay buffer bytes resident in host RAM",
+    "sheeprl_replay_disk_bytes": "replay buffer bytes memmapped on disk",
+    "sheeprl_replay_device_bytes": "replay buffer bytes resident in HBM",
+}

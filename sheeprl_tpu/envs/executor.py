@@ -35,6 +35,7 @@ from gymnasium.vector.utils import CloudpickleWrapper, batch_space
 
 _CMD_STEP = b"S"
 _CMD_CLOSE = b"C"
+_CMD_RESET = b"R"  # followed by pickled (seed, options)
 _ACK_EMPTY = b"n"  # step done, info was {} and no autoreset happened
 
 
@@ -131,7 +132,7 @@ def _worker(
                         pipe.send_bytes(pickle.dumps(("ok", info, has_final, final_info)))
                 elif cmd == _CMD_CLOSE:
                     break
-                else:  # reset: b"R" + pickled (seed, options)
+                else:  # reset: _CMD_RESET + pickled (seed, options)
                     seed, options = pickle.loads(cmd[1:])
                     obs, info = env.reset(seed=seed, options=options)
                     _write_obs(obs_views, index, obs)
@@ -278,7 +279,7 @@ class SharedMemoryVectorEnv(gym.vector.VectorEnv):
             if len(seeds) != self.num_envs:
                 raise ValueError(f"expected {self.num_envs} seeds, got {len(seeds)}")
         for pipe, s in zip(self._pipes, seeds):
-            pipe.send_bytes(b"R" + pickle.dumps((s, options)))
+            pipe.send_bytes(_CMD_RESET + pickle.dumps((s, options)))
         infos: Dict[str, Any] = {}
         for i in range(self.num_envs):
             payload = self._recv(i)

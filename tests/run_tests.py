@@ -15,10 +15,15 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
   `test_telemetry.py` — recompile watchdog, MFU/phase math, /metrics
   endpoint, trace merge, the telemetry CLI e2e — and `test_memory.py` —
   footprint math, transfer guard, donation audit, OOM forensics,
-  memory_report rendering).  The suite is preceded by the fast
-  `tools/check_instrumentation.py` AST lint (train/rollout steps must
-  dispatch through diag.instrument and declare donate_argnums).  ~8 min on
-  one CPU core.  Budget: 25 min.
+  memory_report rendering), plus `tests/test_tools/test_lint.py` (the
+  static-analysis framework itself).  The suite is preceded by the full
+  `tools/sheeprl_lint.py` run (all pass families: INS instrumentation/
+  donation wiring, JIT traced-body purity, CFG config contracts, JRN
+  journal/metric schemas, ASY async-env discipline — see howto/lint.md),
+  which must finish in well under 15 s and writes its JSON report to
+  `logs/lint_report.json`; intentional findings are accepted via
+  `python tools/sheeprl_lint.py --update-baseline` (every new baseline
+  entry needs a one-line why).  ~8 min on one CPU core.  Budget: 25 min.
 * **e2e** — `tests/test_algos/` drives every algorithm through the real CLI
   on dummy envs at 1 and 2 virtual devices.  Slow by nature (each test
   compiles a train step).  Budget: 40 min.
@@ -68,16 +73,22 @@ SUITES: dict[str, tuple[list[str], int]] = {
 def run_suite(name: str, fail_fast: bool) -> int:
     pytest_args, timeout_s = SUITES[name]
     if name == "unit":
-        # fast AST-only pre-step: fail the suite immediately if a training
-        # loop dropped diag.instrument or donate_argnums (the observability
-        # wiring the diagnostics suite then tests behaviorally)
+        # fast AST-only pre-step: the full static analyzer (instrumentation
+        # wiring, jit purity, config contracts, journal schemas, async
+        # discipline — the invariants the diagnostics suite then tests
+        # behaviorally).  JSON artifact lands next to the run logs.
         lint = subprocess.run(
-            [sys.executable, os.path.join(REPO_ROOT, "tools", "check_instrumentation.py")],
+            [
+                sys.executable,
+                os.path.join(REPO_ROOT, "tools", "sheeprl_lint.py"),
+                "--out",
+                os.path.join(REPO_ROOT, "logs", "lint_report.json"),
+            ],
             cwd=REPO_ROOT,
             timeout=120,
         ).returncode
         if lint != 0:
-            print("!! suite 'unit' aborted: tools/check_instrumentation.py failed", flush=True)
+            print("!! suite 'unit' aborted: tools/sheeprl_lint.py failed", flush=True)
             return lint
     cmd = [sys.executable, "-m", "pytest", *pytest_args] + (["-x"] if fail_fast else [])
     print(f"\n=== suite: {name}  (timeout {timeout_s // 60} min) ===\n{' '.join(cmd)}", flush=True)

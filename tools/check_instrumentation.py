@@ -1,28 +1,15 @@
 #!/usr/bin/env python
-"""Static instrumentation lint for the training loops.
+"""Back-compat shim over the INS pass of ``tools/sheeprl_lint.py``.
 
-Observability only works if every hot loop stays wired into it, and that is
-exactly the kind of invariant a refactor silently breaks: a new algorithm
-variant jits its own train step without ``diag.instrument`` (no watchdog, no
-MFU, no transfer guard, no OOM forensics) or drops ``donate_argnums`` on the
-train state (params + optimizer state get double-buffered in HBM).  This lint
-walks ``sheeprl_tpu/algos/`` ASTs — no imports, no jax — and fails when:
-
-1. **donation dropped** — a ``jax.jit`` / ``dp_jit`` call inside any
-   ``make_train_step*`` builder has no (or an empty) ``donate_argnums``;
-2. **train step not instrumented** — a flagship loop module assigns
-   ``train_step = ...`` from something other than a ``*.instrument(...)``
-   call, or has no ``kind="train"`` instrument call at all;
-3. **donation not declared to the audit** — a ``kind="train"``
-   ``*.instrument(...)`` call anywhere omits ``donate_argnums`` (the memory
-   monitor can only verify donations the call site declares);
-4. **rollout not instrumented** — a flagship loop with a host rollout has no
-   ``kind="rollout"`` instrument call (the Dreamer engine is exempt: its
-   player forward is intentionally uninstrumented, compiles are counted by
-   the process-wide jax.monitoring listener).
-
-Run directly or via ``tests/run_tests.py`` (fast unit-suite pre-step) and
-``tests/test_diagnostics/test_memory.py``.
+The instrumentation lint born here (PR 4) now lives in
+``tools/lint/ins_pass.py`` as one pass of the whole-repo analyzer — run
+``python tools/sheeprl_lint.py`` for the full rule set (JIT purity, config
+contracts, journal schemas, async discipline).  This path keeps the original
+interface working: ``run(algos_dir) -> List[str]`` and a ``main()`` with the
+same exit-code contract and message substrings (module-level findings now
+carry a ``:1`` line suffix the legacy output lacked), so
+``tests/run_tests.py`` callers and ``tests/test_diagnostics/test_memory.py``
+need no edits.
 
 Usage:
     python tools/check_instrumentation.py [--algos-dir PATH]
@@ -34,124 +21,20 @@ import argparse
 import ast
 import os
 import sys
-from typing import List, Optional
+from typing import List
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TOOLS_DIR)
+if TOOLS_DIR not in sys.path:
+    sys.path.insert(0, TOOLS_DIR)
 
-# loop modules REQUIRED to dispatch through diag.instrument (the flagship
-# surfaces; dreamer_v3 covers jepa/p2e via the shared _dreamer_main engine)
-FLAGSHIP = {
-    "ppo/ppo.py": {"rollout": True},
-    "ppo/ppo_decoupled.py": {"rollout": True},
-    "a2c/a2c.py": {"rollout": True},
-    "sac/sac.py": {"rollout": True},
-    "sac/sac_decoupled.py": {"rollout": True},
-    "dreamer_v3/dreamer_v3.py": {"rollout": False},
-}
-
-
-def _call_name(node: ast.Call) -> str:
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return ""
-
-
-def _is_jit_call(node: ast.Call) -> bool:
-    return _call_name(node) in ("jit", "dp_jit")
-
-
-def _kwarg(node: ast.Call, name: str) -> Optional[ast.expr]:
-    for kw in node.keywords:
-        if kw.arg == name:
-            return kw.value
-    return None
-
-
-def _donates(node: ast.Call) -> bool:
-    value = _kwarg(node, "donate_argnums")
-    if value is None:
-        return False
-    # an explicitly empty tuple/list is as bad as none
-    if isinstance(value, (ast.Tuple, ast.List)) and not value.elts:
-        return False
-    return True
-
-
-def _instrument_kind(node: ast.Call) -> Optional[str]:
-    """The kind of a ``*.instrument(...)`` call (default 'train'), or None if
-    the node is not an instrument call."""
-    if _call_name(node) != "instrument":
-        return None
-    kind = _kwarg(node, "kind")
-    if kind is None:
-        return "train"
-    if isinstance(kind, ast.Constant) and isinstance(kind.value, str):
-        return kind.value
-    return "?"
-
-
-class _Scanner(ast.NodeVisitor):
-    def __init__(self, rel_path: str):
-        self.rel_path = rel_path
-        self.errors: List[str] = []
-        self.instrument_kinds: List[str] = []
-        self._fn_stack: List[str] = []
-
-    def _in_train_step_builder(self) -> bool:
-        return any(name.startswith("make_train_step") for name in self._fn_stack)
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self._fn_stack.append(node.name)
-        self.generic_visit(node)
-        self._fn_stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef  # pragma: no cover - no async defs
-
-    def visit_Call(self, node: ast.Call) -> None:
-        if _is_jit_call(node) and self._in_train_step_builder():
-            if not _donates(node):
-                self.errors.append(
-                    f"{self.rel_path}:{node.lineno}: {_call_name(node)}(...) inside a make_train_step "
-                    "builder has no (or an empty) donate_argnums — the train state gets "
-                    "double-buffered in HBM"
-                )
-        kind = _instrument_kind(node)
-        if kind is not None:
-            self.instrument_kinds.append(kind)
-            if kind == "train" and not _donates(node):
-                self.errors.append(
-                    f"{self.rel_path}:{node.lineno}: instrument(..., kind=\"train\") does not declare "
-                    "donate_argnums — the donation audit cannot verify what it does not know about"
-                )
-        self.generic_visit(node)
-
-    def visit_Assign(self, node: ast.Assign) -> None:
-        # `train_step = <expr>`: the expr must be a *.instrument(...) call
-        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
-        if "train_step" in targets:
-            value = node.value
-            if not (isinstance(value, ast.Call) and _call_name(value) == "instrument"):
-                self.errors.append(
-                    f"{self.rel_path}:{node.lineno}: `train_step = ...` is not dispatched through "
-                    "diag.instrument — no watchdog/MFU/transfer-guard/OOM-forensics on this loop"
-                )
-        self.generic_visit(node)
-
-
-def scan_file(path: str, rel_path: str) -> _Scanner:
-    with open(path, encoding="utf-8") as fp:
-        tree = ast.parse(fp.read(), filename=rel_path)
-    scanner = _Scanner(rel_path)
-    scanner.visit(tree)
-    return scanner
+from lint import ins_pass  # noqa: E402
 
 
 def run(algos_dir: str) -> List[str]:
+    """Lint ``algos_dir`` and return findings as the legacy message strings."""
+    trees = {}
     errors: List[str] = []
-    seen_flagship = set()
     for root, _, files in sorted(os.walk(algos_dir)):
         for name in sorted(files):
             if not name.endswith(".py"):
@@ -159,20 +42,12 @@ def run(algos_dir: str) -> List[str]:
             path = os.path.join(root, name)
             rel = os.path.relpath(path, algos_dir).replace(os.sep, "/")
             try:
-                scanner = scan_file(path, rel)
+                with open(path, encoding="utf-8") as fp:
+                    trees[rel] = ast.parse(fp.read(), filename=rel)
             except SyntaxError as err:  # pragma: no cover - repo wouldn't import
                 errors.append(f"{rel}: unparseable: {err}")
-                continue
-            errors.extend(scanner.errors)
-            spec = FLAGSHIP.get(rel)
-            if spec is not None:
-                seen_flagship.add(rel)
-                if "train" not in scanner.instrument_kinds:
-                    errors.append(f"{rel}: no instrument(..., kind=\"train\") call — train step unobserved")
-                if spec["rollout"] and "rollout" not in scanner.instrument_kinds:
-                    errors.append(f"{rel}: no instrument(..., kind=\"rollout\") call — rollout unobserved")
-    for missing in sorted(set(FLAGSHIP) - seen_flagship):
-        errors.append(f"{missing}: flagship loop file not found (moved? update tools/check_instrumentation.py)")
+    for finding in ins_pass.scan_trees(trees):
+        errors.append(f"{finding.file}:{finding.line}: {finding.message}")
     return errors
 
 

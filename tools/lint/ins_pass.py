@@ -1,0 +1,194 @@
+"""INS — instrumentation & donation wiring of the training loops.
+
+The observability stack (watchdog, MFU, transfer guard, donation audit, OOM
+forensics) only sees loops that dispatch through ``diag.instrument``, and the
+memory monitor only verifies donations the call site declares.  This pass is
+``tools/check_instrumentation.py`` (PR 4) migrated into the framework — the
+old path remains as a thin shim over this module.
+
+Rules:
+
+* **INS001** — a ``jax.jit`` / ``dp_jit`` call inside a ``make_train_step*``
+  builder has no (or an empty) ``donate_argnums``;
+* **INS002** — ``train_step = ...`` is assigned from something other than a
+  ``*.instrument(...)`` call;
+* **INS003** — an ``instrument(..., kind="train")`` call omits
+  ``donate_argnums``;
+* **INS004 / INS005** — a flagship loop module has no ``kind="train"`` /
+  ``kind="rollout"`` instrument call at all;
+* **INS006** — a flagship loop file vanished (moved without updating the
+  lint's map).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from lint import Finding
+from lint.loader import RepoIndex, call_name, keyword_value
+
+ALGOS_PREFIX = "sheeprl_tpu/algos/"
+
+# loop modules REQUIRED to dispatch through diag.instrument (the flagship
+# surfaces; dreamer_v3 covers jepa/p2e via the shared _dreamer_main engine).
+# Keys are paths relative to the algos dir.
+FLAGSHIP = {
+    "ppo/ppo.py": {"rollout": True},
+    "ppo/ppo_decoupled.py": {"rollout": True},
+    "a2c/a2c.py": {"rollout": True},
+    "sac/sac.py": {"rollout": True},
+    "sac/sac_decoupled.py": {"rollout": True},
+    "dreamer_v3/dreamer_v3.py": {"rollout": False},
+}
+
+RULES = {
+    "INS001": "jit inside a make_train_step builder without donate_argnums",
+    "INS002": "train_step assigned without going through diag.instrument",
+    "INS003": "instrument(kind='train') without a donate_argnums declaration",
+    "INS004": "flagship loop has no instrument(kind='train') call",
+    "INS005": "flagship loop has no instrument(kind='rollout') call",
+    "INS006": "flagship loop file not found",
+}
+
+
+def _donates(node: ast.Call) -> bool:
+    value = keyword_value(node, "donate_argnums")
+    if value is None:
+        return False
+    # an explicitly empty tuple/list is as bad as none
+    if isinstance(value, (ast.Tuple, ast.List)) and not value.elts:
+        return False
+    return True
+
+
+def _instrument_kind(node: ast.Call) -> Optional[str]:
+    """The kind of a ``*.instrument(...)`` call (default 'train'), or None if
+    the node is not an instrument call."""
+    if call_name(node) != "instrument":
+        return None
+    kind = keyword_value(node, "kind")
+    if kind is None:
+        return "train"
+    if isinstance(kind, ast.Constant) and isinstance(kind.value, str):
+        return kind.value
+    return "?"
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, rel_path: str):
+        self.rel_path = rel_path
+        self.findings: List[Finding] = []
+        self.instrument_kinds: List[str] = []
+        self._fn_stack: List[str] = []
+
+    def _in_train_step_builder(self) -> bool:
+        return any(name.startswith("make_train_step") for name in self._fn_stack)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # pragma: no cover - no async defs
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if call_name(node) in ("jit", "dp_jit") and self._in_train_step_builder():
+            if not _donates(node):
+                self.findings.append(
+                    Finding(
+                        "INS001",
+                        "error",
+                        self.rel_path,
+                        node.lineno,
+                        f"{call_name(node)}(...) inside a make_train_step builder has "
+                        "no (or an empty) donate_argnums — the train state gets "
+                        "double-buffered in HBM",
+                    )
+                )
+        kind = _instrument_kind(node)
+        if kind is not None:
+            self.instrument_kinds.append(kind)
+            if kind == "train" and not _donates(node):
+                self.findings.append(
+                    Finding(
+                        "INS003",
+                        "error",
+                        self.rel_path,
+                        node.lineno,
+                        'instrument(..., kind="train") does not declare donate_argnums '
+                        "— the donation audit cannot verify what it does not know about",
+                    )
+                )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # `train_step = <expr>`: the expr must be a *.instrument(...) call
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "train_step" in targets:
+            value = node.value
+            if not (isinstance(value, ast.Call) and call_name(value) == "instrument"):
+                self.findings.append(
+                    Finding(
+                        "INS002",
+                        "error",
+                        self.rel_path,
+                        node.lineno,
+                        "`train_step = ...` is not dispatched through diag.instrument — "
+                        "no watchdog/MFU/transfer-guard/OOM-forensics on this loop",
+                    )
+                )
+        self.generic_visit(node)
+
+
+def scan_trees(trees: Dict[str, ast.Module], file_prefix: str = "") -> List[Finding]:
+    """Scan parsed modules keyed by algos-relative path.  ``file_prefix`` is
+    prepended to reported paths (empty for the shim's standalone mode)."""
+    findings: List[Finding] = []
+    seen_flagship = set()
+    for rel in sorted(trees):
+        scanner = _Scanner(file_prefix + rel)
+        scanner.visit(trees[rel])
+        findings.extend(scanner.findings)
+        spec = FLAGSHIP.get(rel)
+        if spec is not None:
+            seen_flagship.add(rel)
+            if "train" not in scanner.instrument_kinds:
+                findings.append(
+                    Finding(
+                        "INS004",
+                        "error",
+                        file_prefix + rel,
+                        1,
+                        'no instrument(..., kind="train") call — train step unobserved',
+                    )
+                )
+            if spec["rollout"] and "rollout" not in scanner.instrument_kinds:
+                findings.append(
+                    Finding(
+                        "INS005",
+                        "error",
+                        file_prefix + rel,
+                        1,
+                        'no instrument(..., kind="rollout") call — rollout unobserved',
+                    )
+                )
+    for missing in sorted(set(FLAGSHIP) - seen_flagship):
+        findings.append(
+            Finding(
+                "INS006",
+                "error",
+                file_prefix + missing,
+                1,
+                "flagship loop file not found (moved? update tools/lint/ins_pass.py)",
+            )
+        )
+    return findings
+
+
+def run(index: RepoIndex) -> List[Finding]:
+    trees = {
+        path[len(ALGOS_PREFIX) :]: tree
+        for path, tree in index.modules(ALGOS_PREFIX)
+    }
+    return scan_trees(trees, file_prefix=ALGOS_PREFIX)

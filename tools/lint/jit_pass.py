@@ -1,0 +1,244 @@
+"""JIT — purity of traced step bodies.
+
+A jitted train step that calls ``time.time()`` or ``np.random.*`` silently
+bakes ONE value into the compiled graph (wrong forever after), and a
+``.item()`` / ``device_get`` / ``block_until_ready`` inside a traced body is
+a blocking host sync on the hot path — the exact failure the ROADMAP's
+"as fast as the hardware allows" north star cannot absorb.  This pass finds
+every function the tree traces — ``@jax.jit`` / ``@dp_jit`` decorated,
+passed to a ``jit(...)`` / ``dp_jit(...)`` / ``*.instrument(...)`` call, or
+nested inside one of those (closures execute at trace time) — and flags the
+impure calls inside.
+
+Rules:
+
+* **JIT101** — host RNG (``np.random.*`` / stdlib ``random.*``) in a traced
+  body (use ``jax.random`` with an explicit key);
+* **JIT102** — wall clock (``time.time`` / ``perf_counter`` / ``monotonic``
+  / ``time_ns`` / ``process_time``) in a traced body;
+* **JIT103** — blocking host sync in a traced body: ``.item()``,
+  ``jax.device_get``, ``.block_until_ready()``;
+* **JIT104** — bare ``print`` in a traced body (runs once at trace time,
+  then never again — use ``jax.debug.print``);
+* **JIT105** — ``float()`` / ``int()`` / ``bool()`` on a traced argument
+  (concretization error or silent host sync).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from lint import Finding
+from lint.loader import RepoIndex, attr_chain, call_name
+
+JIT_CALLEES = ("jit", "dp_jit")
+CLOCK_FNS = ("time", "perf_counter", "monotonic", "time_ns", "process_time")
+STDLIB_RANDOM_FNS = (
+    "random",
+    "randint",
+    "uniform",
+    "choice",
+    "choices",
+    "shuffle",
+    "sample",
+    "randrange",
+    "gauss",
+    "normalvariate",
+)
+
+RULES = {
+    "JIT101": "host RNG (np.random/stdlib random) inside a traced body",
+    "JIT102": "wall clock read inside a traced body",
+    "JIT103": "blocking host sync (.item/device_get/block_until_ready) inside a traced body",
+    "JIT104": "bare print inside a traced body",
+    "JIT105": "float/int/bool on a traced argument",
+}
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    chain = attr_chain(dec)
+    if chain and chain[-1] in JIT_CALLEES:
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(...) / @dp_jit(...) / @partial(jax.jit, ...)
+        func_chain = attr_chain(dec.func)
+        if func_chain and func_chain[-1] in JIT_CALLEES:
+            return True
+        if func_chain and func_chain[-1] == "partial":
+            for arg in dec.args:
+                arg_chain = attr_chain(arg)
+                if arg_chain and arg_chain[-1] in JIT_CALLEES:
+                    return True
+    return False
+
+
+def _traced_roots(tree: ast.Module) -> List[ast.AST]:
+    """Function defs the module traces, as a transitive closure: jit-decorated
+    or referenced by name in a jit()/dp_jit()/instrument() call, PLUS any
+    same-module function a traced body references by name (``loss_fn`` called
+    — or handed to ``jax.grad``/``lax.scan`` — inside a jitted ``update``
+    executes at trace time just the same)."""
+    jitted_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name in JIT_CALLEES and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                jitted_names.add(arg.id)
+        elif name == "instrument" and len(node.args) >= 2:
+            # diag.instrument("name", fn, ...): fn is (already) a jitted step
+            arg = node.args[1]
+            if isinstance(arg, ast.Name):
+                jitted_names.add(arg.id)
+    by_name: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    roots: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(fn: ast.AST) -> None:
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        roots.append(fn)
+        # closure: names a traced body references pull their defs in
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                for target in by_name.get(node.id, []):
+                    add(target)
+
+    for fns in by_name.values():
+        for fn in fns:
+            if fn.name in jitted_names or any(_is_jit_decorator(d) for d in fn.decorator_list):
+                add(fn)
+    return roots
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = {a.arg for a in args.args + args.posonlyargs + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def _check_body(root: ast.AST, rel_path: str, findings: List[Finding]) -> None:
+    # params of the root and every nested def: all are traced values
+    traced_params: Set[str] = set()
+    for node in ast.walk(root):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            traced_params |= _param_names(node)
+
+    for stmt in root.body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func) or ()
+            name = call_name(node)
+            where = f"traced body of `{root.name}`"
+            if len(chain) >= 2 and chain[0] in ("np", "numpy") and chain[1] == "random":
+                findings.append(
+                    Finding(
+                        "JIT101",
+                        "error",
+                        rel_path,
+                        node.lineno,
+                        f"host RNG `{'.'.join(chain)}(...)` in the {where} — the value is "
+                        "baked in at trace time; use jax.random with an explicit key",
+                    )
+                )
+            elif len(chain) == 2 and chain[0] == "random" and chain[1] in STDLIB_RANDOM_FNS:
+                findings.append(
+                    Finding(
+                        "JIT101",
+                        "error",
+                        rel_path,
+                        node.lineno,
+                        f"host RNG `random.{chain[1]}(...)` in the {where} — the value is "
+                        "baked in at trace time; use jax.random with an explicit key",
+                    )
+                )
+            elif len(chain) == 2 and chain[0] == "time" and chain[1] in CLOCK_FNS:
+                findings.append(
+                    Finding(
+                        "JIT102",
+                        "error",
+                        rel_path,
+                        node.lineno,
+                        f"wall clock `time.{chain[1]}()` in the {where} — traced once, "
+                        "constant forever; measure around the dispatch instead",
+                    )
+                )
+            elif name == "item" and isinstance(node.func, ast.Attribute) and not node.args:
+                findings.append(
+                    Finding(
+                        "JIT103",
+                        "error",
+                        rel_path,
+                        node.lineno,
+                        f"`.item()` in the {where} — blocking device->host sync on the "
+                        "hot path (concretization error under jit)",
+                    )
+                )
+            elif chain[-1:] == ("device_get",) or name == "block_until_ready":
+                findings.append(
+                    Finding(
+                        "JIT103",
+                        "error",
+                        rel_path,
+                        node.lineno,
+                        f"`{'.'.join(chain) or name}(...)` in the {where} — blocking "
+                        "host sync inside a traced body",
+                    )
+                )
+            elif name == "print" and isinstance(node.func, ast.Name):
+                findings.append(
+                    Finding(
+                        "JIT104",
+                        "error",
+                        rel_path,
+                        node.lineno,
+                        f"bare `print` in the {where} — runs once at trace time, never "
+                        "per step; use jax.debug.print",
+                    )
+                )
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in traced_params
+            ):
+                findings.append(
+                    Finding(
+                        "JIT105",
+                        "error",
+                        rel_path,
+                        node.lineno,
+                        f"`{node.func.id}({node.args[0].id})` on a traced argument in the "
+                        f"{where} — concretization error or silent host sync",
+                    )
+                )
+
+
+def run(index: RepoIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, tree in index.modules("sheeprl_tpu/"):
+        for root in _traced_roots(tree):
+            _check_body(root, path, findings)
+    # a nested def is walked inside its parent AND as its own closure member
+    # when referenced by name — keep one finding per site
+    unique: List[Finding] = []
+    seen = set()
+    for finding in findings:
+        key = (finding.rule, finding.file, finding.line, finding.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(finding)
+    return unique
