@@ -516,6 +516,128 @@ def measure_env_overlap(
     }
 
 
+def measure_env_scale(
+    num_envs_list=(4, 16, 64, 256),
+    iters: int = 30,
+    warmup_iters: int = 3,
+    sleep_ms: float = 0.5,
+    envs_per_worker=None,
+    with_train: bool = True,
+    precision: str = "bf16-mixed",
+    train_size: str = "XS",
+):
+    """Many-env player scaling sweep (ISSUE 7): sharded shm executor +
+    device-resident batched inference over ``num_envs`` ∈ {4..256}.
+
+    Per env count the loop is the rewired hot-loop shape — stage the batched
+    obs slab with ONE ``device_put``, run a tiny jitted policy, fetch the
+    actions with ONE blocking ``device_get``, ``step_async``/``step_wait``
+    the sharded ``SharedMemoryVectorEnv`` (optionally dispatching a DV3-XS
+    gradient step inside the overlap window).  Reported per N:
+
+    * ``env_steps_per_sec`` — N * iters / wall-clock; the acceptance signal
+      is monotonic growth 4 → 64 (per-step fixed costs amortize over the
+      slab instead of multiplying with it);
+    * ``fetch_amortization`` — env steps per blocking d2h fetch (= N by
+      construction of the batched-inference path; reported measured, not
+      assumed);
+    * ``grad_steps_per_sec`` — gradient steps landed inside the env-overlap
+      windows (None when ``with_train`` is off, e.g. the CPU liveness probe).
+
+    ``sleep_ms`` gives the dummy envs a deterministic per-step latency so the
+    sweep exercises real worker parallelism, not just IPC overhead.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+    from sheeprl_tpu.envs.executor import SharedMemoryVectorEnv
+    from sheeprl_tpu.envs.pipeline import PipelinedVectorEnv
+
+    train_step = state = batch = None
+    if with_train:
+        _, train_step, state, batch = build_train_step_and_batch(
+            precision,
+            size=train_size,
+            batch_size=4,
+            sequence_length=16,
+            extra_overrides=[
+                "algo.cnn_keys.encoder=[]",
+                "algo.cnn_keys.decoder=[]",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.mlp_keys.decoder=[state]",
+            ],
+        )
+        state["key"] = jax.random.PRNGKey(0)
+
+    key = jax.random.PRNGKey(1)
+    w = jax.device_put(jax.random.normal(key, (8, 4), jnp.float32))
+    stage_sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+    @jax.jit
+    def policy(w, obs):  # tiny batched policy: [N, 8] -> [N] actions
+        return jnp.argmax(obs @ w, axis=-1) % 2
+
+    results = {
+        "num_envs": [],
+        "env_steps_per_sec": [],
+        "fetch_amortization": [],
+        "grad_steps_per_sec": [],
+        "envs_per_worker": [],
+        "sleep_ms": sleep_ms,
+        "iters": iters,
+    }
+    for n in num_envs_list:
+        fns = [
+            (lambda: DiscreteDummyEnv(n_steps=1_000_000, image_size=(3, 8, 8), vector_shape=(8,), sleep_ms=sleep_ms))
+            for _ in range(n)
+        ]
+        envs = PipelinedVectorEnv(SharedMemoryVectorEnv(fns, envs_per_worker=envs_per_worker))
+        try:
+            obs, _ = envs.reset(seed=0)
+
+            def one_iter(obs, fetches, grad_steps):
+                obs_dev = jax.device_put(
+                    np.asarray(obs["state"], np.float32).reshape(n, -1), stage_sharding
+                )
+                acts = policy(w, obs_dev)
+                (actions,) = jax.device_get((acts,))  # the ONE blocking d2h
+                fetches += 1
+                envs.step_async(actions.astype(np.int64))
+                if train_step is not None:
+                    state["key"], sub = jax.random.split(state["key"])
+                    state["params"], state["opt_states"], state["moments_state"], metrics = train_step(
+                        state["params"], state["opt_states"], state["moments_state"], batch, sub, jnp.float32(0.02)
+                    )
+                    np.asarray(metrics)  # value barrier inside the overlap window
+                    grad_steps += 1
+                obs = envs.step_wait()[0]
+                return obs, fetches, grad_steps
+
+            fetches = grad_steps = 0
+            for _ in range(warmup_iters):
+                obs, fetches, grad_steps = one_iter(obs, fetches, grad_steps)
+            fetches = grad_steps = 0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                obs, fetches, grad_steps = one_iter(obs, fetches, grad_steps)
+            elapsed = time.perf_counter() - t0
+        finally:
+            envs.close()
+        results["num_envs"].append(int(n))
+        results["env_steps_per_sec"].append(round(n * iters / elapsed, 1))
+        results["fetch_amortization"].append(round(n * iters / max(1, fetches), 1))
+        results["grad_steps_per_sec"].append(
+            round(grad_steps / elapsed, 3) if train_step is not None else None
+        )
+        results["envs_per_worker"].append(int(envs.envs.envs_per_worker))
+    sps = results["env_steps_per_sec"]
+    upto64 = [v for n, v in zip(results["num_envs"], sps) if n <= 64]
+    results["monotonic_4_to_64"] = all(b >= a for a, b in zip(upto64, upto64[1:]))
+    return results
+
+
 def measure_fetch_rtt():
     """Blocking value-fetch round trip of the device link (through the axon
     tunnel this is ~90-110 ms and dominates the e2e loop's critical path; on
@@ -646,6 +768,15 @@ def _run_cpu_fallback(record: dict, precision: str) -> None:
         record["grad_steps_per_sec_compute_XS"] = compute["grad_steps_per_sec_compute"]
     except Exception as err:  # noqa: BLE001 — the liveness number must land regardless
         record.setdefault("stage_errors", {})["compute_XS"] = repr(err)
+    # env-scale sanity probe (CPU): smaller sweep, no gradient steps — the
+    # sleep_ms dummy sweep still proves env_steps_per_sec monotonicity and
+    # lands the fields so cross-round JSON aggregation never misses them
+    try:
+        record["env_scale"] = measure_env_scale(
+            num_envs_list=(4, 16, 64), iters=12, sleep_ms=0.5, with_train=False
+        )
+    except Exception as err:  # noqa: BLE001
+        record.setdefault("stage_errors", {})["env_scale"] = repr(err)
 
 
 def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
@@ -705,6 +836,14 @@ def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
         record["grad_steps_per_sec_env_serialized"] = env_overlap["grad_steps_per_sec_env_serialized"]
         record["grad_steps_per_sec_env_pipelined"] = env_overlap["grad_steps_per_sec_env_pipelined"]
         record.update({k: v for k, v in env_overlap.items() if not k.startswith("grad_steps")})
+
+    # many-env player scaling sweep (ISSUE 7): sharded shm executor +
+    # batched inference over num_envs 4..256, DV3-XS grad steps inside the
+    # overlap windows; the acceptance signal is env_steps_per_sec growing
+    # monotonically 4 -> 64 with fetch amortization >= 16x at 64 envs
+    env_scale = stage("env_scale", 300, lambda: measure_env_scale(precision=precision))
+    if env_scale:
+        record["env_scale"] = env_scale
 
     # north-star config (BASELINE.md §C): XL single-chip compute + MFU, at the
     # reference batch (16) and at the MXU-saturating batch (64)

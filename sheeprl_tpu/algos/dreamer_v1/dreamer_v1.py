@@ -29,6 +29,7 @@ from sheeprl_tpu.algos.dreamer_v1.utils import (  # noqa: F401
 )
 from sheeprl_tpu.algos.dreamer_v2.loss import normal_log_prob
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
 from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.ops.distributions import Bernoulli
@@ -360,10 +361,8 @@ def main(runtime, cfg):
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
 
-    step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
-    for k in obs_keys:
-        step_data[k] = np.asarray(obs[k])[np.newaxis]
+    step_data: Dict[str, np.ndarray] = step_slab(num_envs, {k: obs[k] for k in obs_keys})
     step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
@@ -428,14 +427,21 @@ def main(runtime, cfg):
                     for k in obs_keys:
                         real_next_obs[k][idx] = np.asarray(final_obs[k])
 
-        for k in obs_keys:
-            step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        step_data.update(
+            step_slab(
+                num_envs,
+                {
+                    **{k: next_obs[k] for k in obs_keys},
+                    "terminated": terminated,
+                    "truncated": truncated,
+                    "rewards": rewards,
+                },
+                dtypes={"terminated": np.float32, "truncated": np.float32, "rewards": np.float32},
+            )
+        )
         obs = next_obs
-
-        rewards = np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
-        step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
-        step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
-        step_data["rewards"] = np.tanh(rewards) if cfg.env.clip_rewards else rewards
+        if cfg.env.clip_rewards:
+            step_data["rewards"] = np.tanh(step_data["rewards"])
 
         dones_idxes = dones.nonzero()[0].tolist()
         if dones_idxes:

@@ -45,7 +45,9 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
+from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.envs.env import make_env_fns, pipelined_vector_env
+from sheeprl_tpu.envs.player import obs_sharding
 from sheeprl_tpu.ops.distributions import (
     Bernoulli,
     MSEDistribution,
@@ -547,6 +549,9 @@ def _dreamer_main(
     diag.register_footprint("params", params)
     diag.register_footprint("opt_state", opt_states)
     diag.register_footprint("moments", moments_state)
+    # one staged h2d per vector step for the player's obs slab (see
+    # envs/player.py); the action fetch below is the one blocking d2h
+    stage_sharding = obs_sharding(runtime.mesh if world_size > 1 else None)
 
     buffer_size = cfg.buffer.size // num_envs if not cfg.dry_run else 2
     # HBM-resident replay when buffer.device=True: frames never leave the
@@ -587,10 +592,8 @@ def _dreamer_main(
         ratio.load_state_dict(state["ratio"])
 
     # ---- first obs (reference dreamer_v3.py:578-589) ----------------------
-    step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
-    for k in obs_keys:
-        step_data[k] = np.asarray(obs[k])[np.newaxis]
+    step_data: Dict[str, np.ndarray] = step_slab(num_envs, {k: obs[k] for k in obs_keys})
     step_data["rewards"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["truncated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
@@ -616,6 +619,7 @@ def _dreamer_main(
 
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
+        diag.note_env_steps(num_envs)
 
         # ---- policy forward + env dispatch + replay write -----------------
         # Split-phase iteration: the player forward is dispatched, its action
@@ -648,7 +652,9 @@ def _dreamer_main(
                 step_data["actions"] = actions.reshape(1, num_envs, -1)
             else:
                 rng_key, step_key = jax.random.split(rng_key)
-                torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+                torch_obs = prepare_obs(
+                    obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs, sharding=stage_sharding
+                )
                 # mask_* observation keys feed MinedojoActor's hierarchical
                 # action masking (reference dreamer_v3.py:614-617)
                 mask = {k: v for k, v in torch_obs.items() if k.startswith("mask")} or None
@@ -661,6 +667,7 @@ def _dreamer_main(
                     # (no fetch needed for the write)
                     step_data["actions"] = jnp.reshape(actions_jnp, (1, num_envs, -1))
                     rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                diag.note_fetch()  # the iteration's ONE blocking d2h
                 actions = np.asarray(actions_jnp)  # blocking value fetch
                 real_actions = split_real_actions(actions)
                 if not use_device_buffer:
@@ -754,14 +761,21 @@ def _dreamer_main(
                     for k in obs_keys:
                         real_next_obs[k][idx] = np.asarray(final_obs[k])
 
-        for k in obs_keys:
-            step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        step_data.update(
+            step_slab(
+                num_envs,
+                {
+                    **{k: next_obs[k] for k in obs_keys},
+                    "terminated": terminated,
+                    "truncated": truncated,
+                    "rewards": rewards,
+                },
+                dtypes={"terminated": np.float32, "truncated": np.float32, "rewards": np.float32},
+            )
+        )
         obs = next_obs
-
-        rewards = np.asarray(rewards, np.float32).reshape(1, num_envs, 1)
-        step_data["terminated"] = np.asarray(terminated, np.float32).reshape(1, num_envs, 1)
-        step_data["truncated"] = np.asarray(truncated, np.float32).reshape(1, num_envs, 1)
-        step_data["rewards"] = np.tanh(rewards) if cfg.env.clip_rewards else rewards
+        if cfg.env.clip_rewards:
+            step_data["rewards"] = np.tanh(step_data["rewards"])
 
         dones_idxes = dones.nonzero()[0].tolist()
         if dones_idxes:

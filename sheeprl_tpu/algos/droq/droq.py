@@ -27,6 +27,7 @@ from sheeprl_tpu.algos.droq.agent import build_agent
 from sheeprl_tpu.algos.droq.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
 from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage, local_sample_size
@@ -253,17 +254,22 @@ def main(runtime, cfg):
                     for k in mlp_keys:
                         real_next_obs[k][idx] = np.asarray(final_obs[k])
 
-        step_data: Dict[str, np.ndarray] = {}
-        step_data["observations"] = np.concatenate(
-            [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
-        )[np.newaxis]
-        step_data["next_observations"] = np.concatenate(
-            [real_next_obs[k].astype(np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
-        )[np.newaxis]
-        step_data["actions"] = actions.reshape(1, num_envs, -1)
-        step_data["rewards"] = rewards[np.newaxis]
-        step_data["terminated"] = np.asarray(terminated).reshape(1, num_envs, -1).astype(np.float32)
-        step_data["truncated"] = np.asarray(truncated).reshape(1, num_envs, -1).astype(np.float32)
+        step_data: Dict[str, np.ndarray] = step_slab(
+            num_envs,
+            {
+                "observations": np.concatenate(
+                    [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+                ),
+                "next_observations": np.concatenate(
+                    [real_next_obs[k].astype(np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+                ),
+                "actions": actions.reshape(num_envs, -1),
+                "rewards": rewards,
+                "terminated": terminated,
+                "truncated": truncated,
+            },
+            dtypes={"terminated": np.float32, "truncated": np.float32},
+        )
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
         obs = next_obs
 

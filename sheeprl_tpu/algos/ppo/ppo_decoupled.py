@@ -31,6 +31,8 @@ from jax.sharding import PartitionSpec as P
 from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.ppo import make_train_step
 from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
+from sheeprl_tpu.data.slab import step_slab
+from sheeprl_tpu.envs.player import fetch_values
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
@@ -152,6 +154,10 @@ def main(runtime, cfg):
         return actions, logprobs, values
 
     _policy_step = diag.instrument("policy_step", _policy_step, kind="rollout")
+    # one staged h2d (straight onto the player device) + one blocking fetch
+    # per vector step (see ppo.py); the reused sharding makes prepare_obs's
+    # single device_put land on the player chip with no second hop
+    stage_sharding = jax.sharding.SingleDeviceSharding(player_device)
 
     def policy_step(params, obs, key):
         obs = jax.device_put(obs, player_device)
@@ -192,10 +198,13 @@ def main(runtime, cfg):
         with timer("Time/env_interaction_time"), diag.span("rollout", role="player"):
             for _ in range(rollout_steps):
                 policy_step_count += num_envs
+                diag.note_env_steps(num_envs)
                 rng_key, step_key = jax.random.split(rng_key)
-                torch_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs)
+                torch_obs = prepare_obs(
+                    obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=num_envs, sharding=stage_sharding
+                )
                 actions, logprobs, values = policy_step(player_params, torch_obs, step_key)
-                actions_np = np.asarray(actions)
+                actions_np, logprobs_np, values_np = fetch_values(actions, logprobs, values)
                 if is_continuous:
                     env_actions = actions_np.reshape(num_envs, -1)
                 elif is_multidiscrete:
@@ -207,12 +216,15 @@ def main(runtime, cfg):
                 # outputs + current obs into the step record (see ppo.py)
                 with diag.span("env_step_async"):
                     envs.step_async(env_actions)
-                step_data: Dict[str, np.ndarray] = {}
-                for k in obs_keys:
-                    step_data[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[1:])
-                step_data["actions"] = actions_np.reshape(1, num_envs, -1)
-                step_data["logprobs"] = np.asarray(logprobs).reshape(1, num_envs, -1)
-                step_data["values"] = np.asarray(values).reshape(1, num_envs, -1)
+                step_data: Dict[str, np.ndarray] = step_slab(
+                    num_envs,
+                    {
+                        **{k: obs[k] for k in obs_keys},
+                        "actions": actions_np,
+                        "logprobs": logprobs_np,
+                        "values": values_np,
+                    },
+                )
                 with diag.span("env_wait"):
                     next_obs, rewards, terminated, truncated, info = envs.step_wait()
                 dones = np.logical_or(terminated, truncated).reshape(num_envs, 1).astype(np.float32)
@@ -227,8 +239,7 @@ def main(runtime, cfg):
                     vals = np.asarray(value_step(player_params, jax.device_put(t_obs, player_device)))
                     rewards[trunc_idx] += cfg.algo.gamma * vals.reshape(-1, 1)
 
-                step_data["rewards"] = rewards.reshape(1, num_envs, -1)
-                step_data["dones"] = dones.reshape(1, num_envs, -1)
+                step_data.update(step_slab(num_envs, {"rewards": rewards, "dones": dones}))
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
                 if "final_info" in info and "episode" in info["final_info"]:

@@ -32,6 +32,7 @@ from sheeprl_tpu.algos.ppo_recurrent.utils import (  # noqa: F401
     test,
 )
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.ops.numerics import gae
@@ -280,18 +281,21 @@ def main(runtime, cfg):
                 if cfg.env.clip_rewards:
                     rewards = np.tanh(rewards)
 
-                step_data: Dict[str, np.ndarray] = {}
-                for k in obs_keys:
-                    step_data[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[1:])
-                step_data["actions"] = actions_np.reshape(1, num_envs, -1)
-                step_data["prev_actions"] = prev_actions_np.reshape(1, num_envs, -1)
-                step_data["logprobs"] = np.asarray(logprobs)[0].reshape(1, num_envs, -1)
-                step_data["values"] = np.asarray(values)[0].reshape(1, num_envs, -1)
-                step_data["rewards"] = rewards.reshape(1, num_envs, -1)
-                step_data["dones"] = dones.reshape(1, num_envs, -1)
-                step_data["resets"] = prev_dones.reshape(1, num_envs, -1)
-                step_data["hx"] = hx0_np.reshape(1, num_envs, -1)
-                step_data["cx"] = cx0_np.reshape(1, num_envs, -1)
+                step_data: Dict[str, np.ndarray] = step_slab(
+                    num_envs,
+                    {
+                        **{k: obs[k] for k in obs_keys},
+                        "actions": actions_np.reshape(num_envs, -1),
+                        "prev_actions": prev_actions_np.reshape(num_envs, -1),
+                        "logprobs": np.asarray(logprobs)[0].reshape(num_envs, -1),
+                        "values": np.asarray(values)[0].reshape(num_envs, -1),
+                        "rewards": rewards,
+                        "dones": dones,
+                        "resets": prev_dones,
+                        "hx": hx0_np.reshape(num_envs, -1),
+                        "cx": cx0_np.reshape(num_envs, -1),
+                    },
+                )
                 rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
                 if "final_info" in info and "episode" in info["final_info"]:

@@ -27,7 +27,9 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
+from sheeprl_tpu.envs.player import obs_sharding
 from sheeprl_tpu.parallel.dp import local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -245,6 +247,8 @@ def main(runtime, cfg):
         return actions
 
     policy_step = diag.instrument("policy_step", policy_step, kind="rollout")
+    # one staged h2d + one blocking action fetch per vector step (see ppo.py)
+    stage_sharding = obs_sharding(runtime.mesh if world_size > 1 else None)
 
     rb = ReplayBuffer(
         cfg.buffer.size,
@@ -316,12 +320,13 @@ def main(runtime, cfg):
 
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
+        diag.note_env_steps(num_envs)
         with timer("Time/env_interaction_time"), diag.span("rollout"):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
                 rng_key, step_key = jax.random.split(rng_key)
-                flat_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
+                flat_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs, sharding=stage_sharding)
                 actions = np.asarray(policy_step(params["actor"], flat_obs, step_key))
             with diag.span("env_step_async"):
                 envs.step_async(actions.reshape(envs.action_space.shape))
@@ -363,18 +368,22 @@ def main(runtime, cfg):
                     for k in mlp_keys:
                         real_next_obs[k][idx] = np.asarray(final_obs[k])
 
-        step_data: Dict[str, np.ndarray] = {}
-        step_data["observations"] = np.concatenate(
-            [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
-        )[np.newaxis]
+        flat = {
+            "observations": np.concatenate(
+                [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+            ),
+            "actions": actions.reshape(num_envs, -1),
+            "rewards": rewards,
+            "terminated": terminated,
+            "truncated": truncated,
+        }
         if not cfg.buffer.sample_next_obs:
-            step_data["next_observations"] = np.concatenate(
+            flat["next_observations"] = np.concatenate(
                 [real_next_obs[k].astype(np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
-            )[np.newaxis]
-        step_data["actions"] = actions.reshape(1, num_envs, -1)
-        step_data["rewards"] = rewards[np.newaxis]
-        step_data["terminated"] = np.asarray(terminated).reshape(1, num_envs, -1).astype(np.float32)
-        step_data["truncated"] = np.asarray(truncated).reshape(1, num_envs, -1).astype(np.float32)
+            )
+        step_data: Dict[str, np.ndarray] = step_slab(
+            num_envs, flat, dtypes={"terminated": np.float32, "truncated": np.float32}
+        )
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
         obs = next_obs
 

@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 from sheeprl_tpu.algos.sac.agent import build_agent
 from sheeprl_tpu.algos.sac.sac import make_train_step
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
+from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
@@ -121,6 +122,8 @@ def main(runtime, cfg):
         return actions
 
     _policy_step = diag.instrument("policy_step", _policy_step, kind="rollout")
+    # one staged h2d straight onto the player device per vector step
+    stage_sharding = jax.sharding.SingleDeviceSharding(player_device)
 
     def policy_step(actor_params, obs, key):
         return _policy_step(actor_params, jax.device_put(obs, player_device), key)
@@ -198,12 +201,13 @@ def main(runtime, cfg):
 
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
+        diag.note_env_steps(num_envs)
         with timer("Time/env_interaction_time"), diag.span("rollout", role="player"):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
                 rng_key, step_key = jax.random.split(rng_key)
-                flat_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
+                flat_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs, sharding=stage_sharding)
                 actions = np.asarray(policy_step(player_actor_params, flat_obs, step_key))
             with diag.span("env_step_async"):
                 envs.step_async(actions.reshape(envs.action_space.shape))
@@ -240,18 +244,22 @@ def main(runtime, cfg):
                     for k in mlp_keys:
                         real_next_obs[k][idx] = np.asarray(final_obs[k])
 
-        step_data: Dict[str, np.ndarray] = {}
-        step_data["observations"] = np.concatenate(
-            [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
-        )[np.newaxis]
+        flat = {
+            "observations": np.concatenate(
+                [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+            ),
+            "actions": actions.reshape(num_envs, -1),
+            "rewards": rewards,
+            "terminated": terminated,
+            "truncated": truncated,
+        }
         if not cfg.buffer.sample_next_obs:
-            step_data["next_observations"] = np.concatenate(
+            flat["next_observations"] = np.concatenate(
                 [real_next_obs[k].astype(np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
-            )[np.newaxis]
-        step_data["actions"] = actions.reshape(1, num_envs, -1)
-        step_data["rewards"] = rewards[np.newaxis]
-        step_data["terminated"] = np.asarray(terminated).reshape(1, num_envs, -1).astype(np.float32)
-        step_data["truncated"] = np.asarray(truncated).reshape(1, num_envs, -1).astype(np.float32)
+            )
+        step_data: Dict[str, np.ndarray] = step_slab(
+            num_envs, flat, dtypes={"terminated": np.float32, "truncated": np.float32}
+        )
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
         obs = next_obs
 

@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Dict, Sequence
+from typing import Any, Dict, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -19,11 +19,14 @@ AGGREGATOR_KEYS = {
 MODELS_TO_REGISTER = {"agent"}
 
 
-def prepare_obs(obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1) -> jax.Array:
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1, sharding: Any = None
+) -> jax.Array:
     """Concatenate vector keys into the flat observation the SAC nets consume
-    (reference utils.py:13-24)."""
+    (reference utils.py:13-24) — one staged h2d for the whole slab; pass a
+    reused ``sharding`` (``envs/player.py::obs_sharding``) from hot loops."""
     arr = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1)
-    return jnp.asarray(arr)
+    return jnp.asarray(arr) if sharding is None else jax.device_put(arr, sharding)
 
 
 def test(actor_apply, actor_params, env, runtime, cfg, log_dir: str) -> float:

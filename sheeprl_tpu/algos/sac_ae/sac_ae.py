@@ -31,6 +31,7 @@ from sheeprl_tpu.algos.sac_ae.utils import (  # noqa: F401
     test,
 )
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
 from sheeprl_tpu.parallel.dp import P, batch_spec, dp_axis, dp_jit, fold_key, pmean_tree, stage, local_sample_size
@@ -364,13 +365,17 @@ def main(runtime, cfg):
                     for k in obs_keys:
                         real_next_obs[k][idx] = np.asarray(final_obs[k])
 
-        step_data: Dict[str, np.ndarray] = {}
-        for k in obs_keys:
-            step_data[k] = np.asarray(obs[k]).reshape(1, num_envs, *np.asarray(obs[k]).shape[1:])
-        step_data["actions"] = actions.reshape(1, num_envs, -1)
-        step_data["rewards"] = rewards[np.newaxis]
-        step_data["terminated"] = np.asarray(terminated).reshape(1, num_envs, -1).astype(np.float32)
-        step_data["truncated"] = np.asarray(truncated).reshape(1, num_envs, -1).astype(np.float32)
+        step_data: Dict[str, np.ndarray] = step_slab(
+            num_envs,
+            {
+                **{k: obs[k] for k in obs_keys},
+                "actions": actions.reshape(num_envs, -1),
+                "rewards": rewards,
+                "terminated": terminated,
+                "truncated": truncated,
+            },
+            dtypes={"terminated": np.float32, "truncated": np.float32},
+        )
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
         obs = next_obs
 

@@ -250,6 +250,20 @@ def _apply_global_flags(cfg: dotdict) -> None:
     precision = cfg.get("matmul_precision", "default")
     if precision and precision != "default":
         jax.config.update("jax_default_matmul_precision", precision)
+    # persistent compilation cache (ROADMAP item 2): must be set BEFORE the
+    # first compile, which is why it lives here and not in the diagnostics
+    # facade (opened only once the run dir exists).  The facade journals a
+    # `compilation_cache` event at open so the run records where it cached.
+    cache_dir = (cfg.get("diagnostics") or {}).get("compilation_cache_dir")
+    if cache_dir:
+        os.makedirs(str(cache_dir), exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        # default min compile time is 1s — production restarts should also
+        # skip the many sub-second helper jits, not just the train step
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        except AttributeError:  # pragma: no cover - older jax spelling
+            pass
 
 
 def eval_algorithm(cfg: dotdict) -> None:

@@ -472,9 +472,39 @@ class EnvIndependentReplayBuffer:
                 f"The length of 'indices' ({len(indices)}) must equal the env dim of 'data' "
                 f"({next(iter(data.values())).shape[1]})"
             )
+        if validate_args:
+            _validate_add_data(data)
+        # Lockstep fast path (the hot-loop shape: every env adds one step,
+        # every sub-buffer at the same write head, no wrap): write each key's
+        # whole [T, N, ...] slab column-by-column straight into the
+        # sub-buffer storages.  Skips the per-env dict building + per-env
+        # ``add()`` head bookkeeping — the difference between O(N) Python
+        # call machinery and N plain slice assignments per key at 64+ envs.
+        # The wrap/first-add/misaligned cases keep the general path below.
+        steps = next(iter(data.values())).shape[0]
+        bufs = [self._buf[env_idx] for env_idx in indices]
+        first = bufs[0]
+        head = first._pos
+        if (
+            not first.empty
+            and head + steps <= self._buffer_size
+            and first._buf.keys() == data.keys()
+            and len(set(indices)) == len(bufs)
+            and all(not b.empty and b._pos == head for b in bufs)
+        ):
+            for k, v in data.items():
+                for data_idx, b in enumerate(bufs):
+                    b._buf[k][head : head + steps] = v[:, data_idx : data_idx + 1]
+            full = head + steps >= self._buffer_size
+            pos = (head + steps) % self._buffer_size
+            for b in bufs:
+                b._full = b._full or full
+                b._pos = pos
+            return
         for data_idx, env_idx in enumerate(indices):
             env_data = {k: v[:, data_idx : data_idx + 1] for k, v in data.items()}
-            self._buf[env_idx].add(env_data, validate_args=validate_args)
+            # already validated once on the whole slab above
+            self._buf[env_idx].add(env_data, validate_args=False)
 
     def sample(
         self,
@@ -647,6 +677,15 @@ class EpisodeBuffer:
                 raise ValueError(f"Env indices must be in [0, {self._n_envs}), given {env_idxes}")
         if env_idxes is None:
             env_idxes = range(self._n_envs)
+        # Vectorized fast path for the overwhelmingly common slab: no episode
+        # ends anywhere — ONE done-reduction over the whole [T, N] slab and a
+        # per-env view append, instead of a per-env done scan + nonzero.
+        if "terminated" in data and "truncated" in data and not np.logical_or(
+            data["terminated"], data["truncated"]
+        ).any():
+            for i, env in enumerate(env_idxes):
+                self._open_episodes[env].append({k: v[:, i] for k, v in data.items()})
+            return
         for i, env in enumerate(env_idxes):
             env_data = {k: v[:, i] for k, v in data.items()}
             done = np.logical_or(env_data["terminated"], env_data["truncated"])

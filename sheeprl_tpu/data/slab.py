@@ -1,0 +1,50 @@
+"""Vectorized step-slab builder for the training loops' replay writes.
+
+Every hot loop appends one vector step to its replay buffer as a
+``{key: [1, num_envs, ...]}`` dict.  Before this helper each loop hand-rolled
+the slab key by key (``np.asarray(...).reshape(1, num_envs, -1)`` etc.) —
+O(keys) redundant Python per step spread over eleven loops, each a chance to
+drift in dtype or layout.  :func:`step_slab` builds the whole record with one
+vectorized view (or dtype-cast copy) per key and no per-env Python:
+
+* inputs are per-env batched arrays ``[num_envs]`` or ``[num_envs, ...]``
+  (exactly what the vector env / policy fetch returns);
+* 1-D inputs gain the trailing feature axis (``[N] -> [1, N, 1]``), matching
+  the buffer convention every loop used;
+* >=2-D inputs keep their trailing dims (``[N, C, H, W] -> [1, N, C, H, W]``);
+* an optional per-key dtype map applies the cast in the same pass (e.g.
+  ``rewards``/``terminated`` to float32).
+
+``reshape``/``expand_dims`` return views, so the only copies are requested
+dtype casts — the buffer's own ``add`` does the one storage write per key.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+
+def step_slab(
+    num_envs: int,
+    arrays: Mapping[str, Any],
+    dtypes: Optional[Mapping[str, Any]] = None,
+) -> Dict[str, np.ndarray]:
+    """Build the ``[1, num_envs, ...]`` step record for ``ReplayBuffer.add``.
+
+    Raises on a leading-dim mismatch — a key accidentally passed per-env (or
+    already slab-shaped) would otherwise silently write garbage rows.
+    """
+    out: Dict[str, np.ndarray] = {}
+    for key, value in arrays.items():
+        dtype = dtypes.get(key) if dtypes else None
+        arr = np.asarray(value, dtype=dtype)
+        if arr.ndim == 0 or arr.shape[0] != num_envs:
+            raise ValueError(
+                f"step_slab key '{key}' must be [num_envs={num_envs}, ...], got shape {arr.shape}"
+            )
+        if arr.ndim == 1:
+            arr = arr.reshape(num_envs, 1)
+        out[key] = arr[np.newaxis]
+    return out

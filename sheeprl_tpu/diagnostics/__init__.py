@@ -110,6 +110,7 @@ class Diagnostics:
         self.enabled = bool(diag_cfg.get("enabled", False))
         self._journal_cfg = diag_cfg.get("journal") or {}
         self._trace_cfg = diag_cfg.get("trace") or {}
+        self.compilation_cache_dir = diag_cfg.get("compilation_cache_dir") or None
         self.role = str(diag_cfg.get("role") or "main")
         self.sentinel: SentinelSpec = sentinel_spec(cfg or {})
         div_cfg = (diag_cfg.get("sentinel") or {}).get("divergence") or {}
@@ -205,6 +206,12 @@ class Diagnostics:
                 run_id=self.run_id,
                 sentinel_policy=self.sentinel.policy if self.sentinel.enabled else None,
             )
+            if self.compilation_cache_dir:
+                # the cache itself was enabled at CLI startup (before any
+                # compile — cli._apply_global_flags); the journal records
+                # where it lives so restarts/post-mortems can account for
+                # compile time that never shows up
+                self.journal.write("compilation_cache", dir=str(self.compilation_cache_dir))
         if self.memory is not None:
             # opened on every rank: the transfer guard must protect every
             # process; journal writes no-op off rank 0 (journal is None there)
@@ -318,6 +325,19 @@ class Diagnostics:
         if self.telemetry is None:
             return fn
         return self.telemetry.instrument(name, fn, kind=kind, donate_argnums=donate_argnums)
+
+    def note_env_steps(self, n: int) -> None:
+        """Count ``n`` env steps toward ``Telemetry/env_steps_per_sec`` and
+        fetch amortization (loops call it once per vector step with
+        ``num_envs``).  No-op when telemetry is disabled."""
+        if self.telemetry is not None:
+            self.telemetry.note_env_steps(n)
+
+    def note_fetch(self, n: int = 1) -> None:
+        """Count a blocking obs→action fetch outside the instrumented rollout
+        dispatch path (Dreamer's direct action fetch).  No-op when disabled."""
+        if self.telemetry is not None:
+            self.telemetry.note_fetch(n)
 
     def augment_metrics(self, step: Optional[int], metrics: Mapping[str, Any]) -> Mapping[str, Any]:
         """Merge the interval's ``Telemetry/*`` gauges (compute + memory) into

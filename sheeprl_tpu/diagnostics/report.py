@@ -153,6 +153,8 @@ def format_bytes(n: Any) -> str:
 _TELEMETRY_COLUMNS = (
     ("Rewards/rew_avg", "rew", "{:.2f}"),
     ("Telemetry/sps", "sps", "{:.0f}"),
+    ("Telemetry/env_steps_per_sec", "env-sps", "{:.0f}"),
+    ("Telemetry/fetch_amortization", "fetch-amort", "{:.0f}x"),
     ("Telemetry/tflops_per_sec", "tflops", "{:.2f}"),
     ("Telemetry/mfu", "mfu", "{:.1%}"),
 )

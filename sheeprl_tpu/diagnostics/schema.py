@@ -43,6 +43,7 @@ EVENT_KINDS: Dict[str, str] = {
     "telemetry_cost": "compiled-step cost_analysis FLOPs for one instrumented signature",
     "telemetry_fallback": "AOT compile/dispatch failed; the step reverted to native jit dispatch",
     "metrics_server": "the /metrics endpoint address (or its bind failure)",
+    "compilation_cache": "JAX on-disk compilation cache enabled (directory recorded)",
     "telemetry_summary": "closing perf totals (recompiles, compile time, FLOPs, phase seconds)",
     "memory_breakdown": "one-shot static footprint decomposition at first train dispatch",
     "sharding_audit": "per-leaf bytes/sharding table of the first train dispatch",
@@ -81,6 +82,7 @@ METRICS: Dict[str, str] = {
     "sheeprl_compile_seconds_total": "cumulative backend compile wall-clock",
     "sheeprl_sentinel_events_total": "journaled divergence/sentinel findings",
     "sheeprl_train_flops_total": "cumulative FLOPs dispatched through kind=train steps",
+    "sheeprl_env_steps_total": "cumulative environment steps taken by the player",
     # memory counters (MemoryMonitor.snapshot()["counters"])
     "sheeprl_host_transfers_total": "transfer-guard trips journaled",
     "sheeprl_donation_miss_leaves_total": "leaves that missed a declared donation",
@@ -89,6 +91,8 @@ METRICS: Dict[str, str] = {
     "sheeprl_mfu": "model FLOPs utilization vs the device-kind peak",
     "sheeprl_tflops_per_sec": "achieved TFLOP/s over the last interval",
     "sheeprl_sps": "policy steps per second over the last interval",
+    "sheeprl_env_steps_per_sec": "environment steps per second over the last interval",
+    "sheeprl_fetch_amortization": "env steps amortized by each blocking action fetch",
     "sheeprl_recompiles": "recompiles within the last interval",
     "sheeprl_compile_count": "backend compiles within the last interval",
     "sheeprl_compile_time_s": "backend compile seconds within the last interval",

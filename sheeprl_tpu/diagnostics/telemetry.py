@@ -396,6 +396,12 @@ class Telemetry:
         self._calls_interval: Dict[str, int] = {}
         self._train_flops_interval = 0.0
         self._train_flops_total = 0.0
+        # env throughput: vector env steps (note_env_steps) over wall-clock,
+        # and how many of them each blocking rollout fetch amortizes (one
+        # kind="rollout" dispatch == one obs->action->fetch round trip)
+        self._env_steps_interval = 0
+        self._env_steps_total = 0
+        self._rollout_calls_interval = 0
         # watchdog
         self._recompiles_total = 0
         self._recompile_times: deque = deque()
@@ -464,6 +470,23 @@ class Telemetry:
             if inst.kind == "train" and inst.flops_per_call:
                 self._train_flops_interval += inst.flops_per_call
                 self._train_flops_total += inst.flops_per_call
+            if inst.kind == "rollout":
+                self._rollout_calls_interval += 1
+
+    def note_env_steps(self, n: int) -> None:
+        """Count ``n`` environment steps (loops call it once per vector step
+        with ``num_envs``) — feeds ``Telemetry/env_steps_per_sec`` and the
+        fetch-amortization gauge."""
+        with self._lock:
+            self._env_steps_interval += int(n)
+            self._env_steps_total += int(n)
+
+    def note_fetch(self, n: int = 1) -> None:
+        """Count a blocking obs→action fetch that did NOT go through an
+        instrumented ``kind="rollout"`` dispatch (the Dreamer player fetches
+        its action values directly)."""
+        with self._lock:
+            self._rollout_calls_interval += int(n)
 
     def _watchdog_observe(self, inst: _Instrumented, sig, args, kwargs) -> None:
         """One *new* dispatch signature on an already-compiled fn == one
@@ -576,6 +599,14 @@ class Telemetry:
                     out[TELEMETRY_PREFIX + "tflops_per_sec"] = flops_per_s / 1e12
                     if self._peak_flops_total:
                         out[TELEMETRY_PREFIX + "mfu"] = flops_per_s / self._peak_flops_total
+                if self._env_steps_interval > 0:
+                    out[TELEMETRY_PREFIX + "env_steps_per_sec"] = self._env_steps_interval / dt
+                    if self._rollout_calls_interval > 0:
+                        # env steps per blocking obs->action fetch: num_envs
+                        # when the player batches all envs behind one d2h
+                        out[TELEMETRY_PREFIX + "fetch_amortization"] = (
+                            self._env_steps_interval / self._rollout_calls_interval
+                        )
                 if self._phase_interval:
                     buckets: Dict[str, float] = {}
                     for name, secs in self._phase_interval.items():
@@ -592,6 +623,8 @@ class Telemetry:
             self._phase_interval = {}
             self._calls_interval = {}
             self._train_flops_interval = 0.0
+            self._env_steps_interval = 0
+            self._rollout_calls_interval = 0
             self._tick_t = now
             if step is not None:
                 self._tick_step = float(step)
@@ -611,6 +644,7 @@ class Telemetry:
                     "compile_seconds_total": round(self._backend_compile_s, 3),
                     "sentinel_events_total": self._sentinel_events,
                     "train_flops_total": self._train_flops_total,
+                    "env_steps_total": self._env_steps_total,
                 },
                 "policy_steps": self._tick_step,
                 "phase_seconds_total": dict(self._phase_total),

@@ -269,7 +269,11 @@ def pipelined_vector_env(cfg, env_fns):
     if executor == "shared_memory":
         from sheeprl_tpu.envs.executor import SharedMemoryVectorEnv
 
-        envs = SharedMemoryVectorEnv(env_fns, context="spawn")
+        envs = SharedMemoryVectorEnv(
+            env_fns,
+            context="spawn",
+            envs_per_worker=cfg.env.get("envs_per_worker", None),
+        )
     else:
         envs = vectorized_env(env_fns, sync=executor == "sync")
     return PipelinedVectorEnv(envs)

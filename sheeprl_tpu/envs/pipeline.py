@@ -17,8 +17,9 @@ Executors (``cfg.env.executor``):
 * ``async`` — gymnasium ``AsyncVectorEnv`` (one spawned OS process per env);
   its native ``step_async``/``step_wait`` is used directly.
 * ``shared_memory`` — :class:`~sheeprl_tpu.envs.executor.SharedMemoryVectorEnv`,
-  persistent workers with in-place shared obs/action buffers (EnvPool-style:
-  no per-step pickling, one batched copy out).
+  persistent slab workers with in-place shared obs/action buffers
+  (EnvPool-style: no per-step pickling, one batched copy out, one
+  command/ack per worker — ``env.envs_per_worker`` sets the slab size).
 
 All three keep ``SAME_STEP`` autoreset semantics bit-for-bit (golden-tested
 in ``tests/test_envs/test_async_pipeline.py``), and ``step()`` still works

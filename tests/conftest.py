@@ -53,6 +53,18 @@ _TIER1_DEFERRED_TO_CI = {
     "tests/test_parallel/test_precision.py::test_dv3_bf16_true_param_dtype",
     "tests/test_algos/test_algos.py::test_p2e_dv3_finetuning_from_exploration_checkpoint[1]",
     "tests/test_diagnostics/test_cli_e2e.py::test_sigkilled_run_leaves_recoverable_journal",
+    # PR 6 (many-env scaling) added ~40s of tier-1 tests (sharded-shm goldens,
+    # slab-crash recovery, slab-add equivalence, env-telemetry asserts) and
+    # the uncapped suite measured 819s — defer another ~80s of redundant
+    # heavy SIBLINGS (measured with --durations=40): each deferred node's
+    # surface keeps a cheaper tier-1 representative — P2E dv1/dv2 via [1-1],
+    # P2E dv3 + dv3 action-space breadth via their discrete variants (dv3
+    # continuous imagination-gradients stay via test_dreamer_v3
+    # [1-continuous_dummy]), the dv1/dv2 device-buffer e2e via [dreamer_v1].
+    "tests/test_algos/test_algos.py::test_p2e_dv1_dv2_exploration_and_finetuning[1-2]",
+    "tests/test_algos/test_algos.py::test_p2e_dv3_exploration[1-continuous_dummy]",
+    "tests/test_algos/test_algos.py::test_dreamer_v3[1-multidiscrete_dummy]",
+    "tests/test_data/test_device_buffer.py::test_dv1_dv2_e2e_with_device_buffer[dreamer_v2]",
 }
 
 

@@ -8,8 +8,11 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
 * **unit** — everything except the e2e algorithm suite and the multihost
   test: ops goldens vs reference numerics, buffers (host/memmap/HBM),
   models, env layer (incl. `tests/test_envs/test_async_pipeline.py`: the
-  split-phase executor goldens, shm-worker crash recovery, overlap timing,
-  and the `executor=shared_memory` CLI smokes), config/CLI utils,
+  split-phase executor goldens — sharded multi-env slab workers included —
+  shm/slab-worker crash recovery, overlap timing, and the
+  `executor=shared_memory` CLI smokes), buffer slab equivalence
+  (`tests/test_data/test_slab.py`: step_slab layout + whole-slab add vs the
+  per-env path across every buffer class), config/CLI utils,
   sharding-HLO checks, and the diagnostics suite
   (`tests/test_diagnostics/`: journal/sentinel/tracing plus
   `test_telemetry.py` — recompile watchdog, MFU/phase math, /metrics

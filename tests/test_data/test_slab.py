@@ -1,0 +1,220 @@
+"""Vectorized step-slab builder + whole-slab replay-add equivalence (ISSUE 7).
+
+Two contracts:
+
+* ``data/slab.py::step_slab`` builds exactly the ``[1, N, ...]`` records the
+  eleven hot loops used to hand-roll (dtype casts included);
+* every buffer class accepts the whole ``[T, N, ...]`` slab and stores
+  bit-for-bit what the old per-env add path stored — including the
+  ``EnvIndependentReplayBuffer`` lockstep fast path's wrap/misalignment
+  fallbacks and the ``EpisodeBuffer`` no-boundary fast path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+from sheeprl_tpu.data.slab import step_slab
+
+N = 6
+
+
+def _step_arrays(rng, n=N):
+    return {
+        "obs": rng.integers(0, 255, (n, 3, 4, 4)).astype(np.uint8),
+        "state": rng.normal(size=(n, 5)).astype(np.float32),
+        "actions": rng.normal(size=(n, 2)).astype(np.float32),
+        "rewards": rng.normal(size=(n,)),  # float64 from the env, like gym
+        "terminated": rng.integers(0, 2, (n,)).astype(bool),
+        "truncated": np.zeros((n,), bool),
+    }
+
+
+def test_step_slab_matches_hand_rolled_layout():
+    rng = np.random.default_rng(0)
+    arrays = _step_arrays(rng)
+    slab = step_slab(
+        N,
+        arrays,
+        dtypes={"rewards": np.float32, "terminated": np.float32, "truncated": np.float32},
+    )
+    # the exact expressions the loops used before the shared builder
+    np.testing.assert_array_equal(slab["obs"], np.asarray(arrays["obs"]).reshape(1, N, 3, 4, 4))
+    np.testing.assert_array_equal(slab["state"], np.asarray(arrays["state"]).reshape(1, N, -1))
+    np.testing.assert_array_equal(slab["actions"], arrays["actions"].reshape(1, N, -1))
+    np.testing.assert_array_equal(
+        slab["rewards"], np.asarray(arrays["rewards"], np.float32).reshape(1, N, 1)
+    )
+    np.testing.assert_array_equal(
+        slab["terminated"], np.asarray(arrays["terminated"]).reshape(1, N, -1).astype(np.float32)
+    )
+    assert slab["rewards"].dtype == np.float32 and slab["terminated"].dtype == np.float32
+    assert all(v.shape[:2] == (1, N) for v in slab.values())
+
+
+def test_step_slab_rejects_misshaped_keys():
+    with pytest.raises(ValueError, match="num_envs"):
+        step_slab(4, {"x": np.zeros((3, 2))})
+    with pytest.raises(ValueError, match="num_envs"):
+        step_slab(4, {"x": np.float32(1.0)})
+
+
+def _trajectory(rng, steps, n=N):
+    out = []
+    for _ in range(steps):
+        arrays = _step_arrays(rng, n)
+        out.append(
+            step_slab(
+                n,
+                arrays,
+                dtypes={"rewards": np.float32, "terminated": np.float32, "truncated": np.float32},
+            )
+        )
+    return out
+
+
+@pytest.mark.parametrize("cls", [ReplayBuffer, SequentialReplayBuffer])
+def test_flat_buffer_slab_add_equals_column_adds(cls):
+    """Whole-[T, N] slab add == N single-column buffers fed per env."""
+    rng = np.random.default_rng(1)
+    steps = _trajectory(rng, 7)
+    whole = cls(5, N, obs_keys=("obs", "state"))
+    cols = [cls(5, 1, obs_keys=("obs", "state")) for _ in range(N)]
+    for s in steps:  # wraps the size-5 ring
+        whole.add(s)
+        for e, b in enumerate(cols):
+            b.add({k: v[:, e : e + 1] for k, v in s.items()})
+    for k in steps[0]:
+        got = np.asarray(whole[k])
+        for e, b in enumerate(cols):
+            np.testing.assert_array_equal(got[:, e : e + 1], np.asarray(b[k]), err_msg=k)
+    assert whole.full
+
+
+def test_env_independent_slab_add_equals_per_env_path(tmp_path):
+    """The lockstep fast path (and its wrap fallback) stores exactly what the
+    general per-env path stores — memmap storage included."""
+    rng = np.random.default_rng(2)
+    steps = _trajectory(rng, 9)  # buffer_size 4 -> several wraps
+    fast = EnvIndependentReplayBuffer(4, N, obs_keys=("obs", "state"))
+    slow = EnvIndependentReplayBuffer(4, N, obs_keys=("obs", "state"))
+    mm = EnvIndependentReplayBuffer(
+        4, N, obs_keys=("obs", "state"), memmap=True, memmap_dir=tmp_path / "mm"
+    )
+    for s in steps:
+        fast.add(s)
+        mm.add(s)
+        for e in range(N):  # the old per-env route, one env at a time
+            slow.add({k: v[:, e : e + 1] for k, v in s.items()}, indices=[e])
+    for e in range(N):
+        assert fast.buffer[e]._pos == slow.buffer[e]._pos
+        assert fast.buffer[e].full == slow.buffer[e].full
+        for k in steps[0]:
+            np.testing.assert_array_equal(
+                np.asarray(fast.buffer[e][k]), np.asarray(slow.buffer[e][k]), err_msg=k
+            )
+            np.testing.assert_array_equal(
+                np.asarray(mm.buffer[e][k]), np.asarray(slow.buffer[e][k]), err_msg=f"memmap {k}"
+            )
+
+
+def test_env_independent_partial_indices_slab():
+    """Dreamer's reset_data shape: a slab covering a subset of envs."""
+    rng = np.random.default_rng(3)
+    fast = EnvIndependentReplayBuffer(8, 4, obs_keys=("state",))
+    slow = EnvIndependentReplayBuffer(8, 4, obs_keys=("state",))
+    full = step_slab(4, {"state": rng.normal(size=(4, 3)).astype(np.float32)})
+    for b in (fast, slow):
+        b.add(full)
+    subset = {k: v[:, [1, 3]] for k, v in full.items()}
+    fast.add(subset, indices=[1, 3])
+    slow.add({k: v[:, :1] for k, v in subset.items()}, indices=[1])
+    slow.add({k: v[:, 1:] for k, v in subset.items()}, indices=[3])
+    for e in range(4):
+        pos = slow.buffer[e]._pos
+        assert fast.buffer[e]._pos == pos
+        np.testing.assert_array_equal(  # only written rows: storage is np.empty
+            np.asarray(fast.buffer[e]["state"])[:pos], np.asarray(slow.buffer[e]["state"])[:pos]
+        )
+
+
+def _episode_steps(rng, steps, done_at=()):
+    out = []
+    for t in range(steps):
+        arrays = _step_arrays(rng, 3)
+        arrays["terminated"] = np.zeros((3,), bool)
+        arrays["truncated"] = np.zeros((3,), bool)
+        for (tt, env) in done_at:
+            if tt == t:
+                arrays["terminated"][env] = True
+        out.append(
+            step_slab(
+                3,
+                arrays,
+                dtypes={"rewards": np.float32, "terminated": np.float32, "truncated": np.float32},
+            )
+        )
+    return out
+
+
+@pytest.mark.parametrize("done_at", [(), ((4, 0), (6, 2))], ids=["no-boundaries", "boundaries"])
+def test_episode_buffer_slab_add_equals_per_env_path(done_at):
+    """The no-boundary fast path and the boundary path both match per-env
+    adds: same saved episodes, same open-episode chunks."""
+    rng = np.random.default_rng(4)
+    steps = _episode_steps(rng, 8, done_at)
+    fast = EpisodeBuffer(64, minimum_episode_length=1, n_envs=3, obs_keys=("obs", "state"))
+    slow = EpisodeBuffer(64, minimum_episode_length=1, n_envs=3, obs_keys=("obs", "state"))
+    for s in steps:
+        fast.add(s)
+        for e in range(3):
+            slow.add({k: v[:, e : e + 1] for k, v in s.items()}, env_idxes=[e])
+    assert len(fast.buffer) == len(slow.buffer)
+    for ep_f, ep_s in zip(fast.buffer, slow.buffer):
+        for k in ep_f:
+            np.testing.assert_array_equal(np.asarray(ep_f[k]), np.asarray(ep_s[k]), err_msg=k)
+    for chunks_f, chunks_s in zip(fast._open_episodes, slow._open_episodes):
+        total_f = sum(c["rewards"].shape[0] for c in chunks_f)
+        total_s = sum(c["rewards"].shape[0] for c in chunks_s)
+        assert total_f == total_s
+        if chunks_f:
+            cat_f = {k: np.concatenate([c[k] for c in chunks_f]) for k in chunks_f[0]}
+            cat_s = {k: np.concatenate([c[k] for c in chunks_s]) for k in chunks_s[0]}
+            for k in cat_f:
+                np.testing.assert_array_equal(cat_f[k], cat_s[k], err_msg=k)
+
+
+def test_device_buffer_slab_add_equals_indexed_adds():
+    """DeviceSequentialReplayBuffer: one all-env slab add == per-env indexed
+    adds (its scatter is already a single dispatched program; this pins the
+    equivalence the loops rely on)."""
+    from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
+
+    rng = np.random.default_rng(5)
+    n = 4
+    whole = DeviceSequentialReplayBuffer(6, n_envs=n, obs_keys=("state",))
+    per_env = DeviceSequentialReplayBuffer(6, n_envs=n, obs_keys=("state",))
+    for _ in range(3):
+        s = step_slab(
+            n,
+            {
+                "state": rng.normal(size=(n, 3)).astype(np.float32),
+                "actions": rng.normal(size=(n, 2)).astype(np.float32),
+                "rewards": rng.normal(size=(n,)).astype(np.float32),
+                "terminated": np.zeros((n,), np.float32),
+            },
+        )
+        whole.add(s)
+        for e in range(n):
+            per_env.add({k: v[:, e : e + 1] for k, v in s.items()}, indices=[e])
+    sw, sp = whole.state_dict(), per_env.state_dict()
+    np.testing.assert_array_equal(sw["pos"], sp["pos"])
+    for k in sw["buffer"]:
+        np.testing.assert_array_equal(sw["buffer"][k], sp["buffer"][k], err_msg=k)

@@ -177,6 +177,44 @@ def test_interval_math_mfu_sps_and_phase_breakdown():
     assert TELEMETRY_PREFIX + "tflops_per_sec" not in again
 
 
+def test_interval_math_env_throughput_and_fetch_amortization():
+    """ISSUE 7 gauges: env_steps_per_sec over the interval wall-clock and
+    env steps per blocking fetch — rollout dispatches and Dreamer-style
+    direct ``note_fetch`` calls both count as fetches."""
+    clock = FakeClock()
+    tele = Telemetry({"diagnostics": {"telemetry": {"enabled": True}}}, clock=clock)
+    tele.open()
+
+    class Roll:  # stand-in instrumented rollout fn (one dispatch == one fetch)
+        name, kind = "policy_step", "rollout"
+        flops_per_call = None
+
+    tele.interval_metrics(0)
+    for _ in range(5):  # 5 vector steps of 64 envs through the rollout path
+        tele.note_env_steps(64)
+        tele._record_call(Roll())
+    for _ in range(3):  # 3 Dreamer-style vector steps with direct fetches
+        tele.note_env_steps(64)
+        tele.note_fetch()
+    clock.t += 16.0
+    out = tele.interval_metrics(512)
+    assert out[TELEMETRY_PREFIX + "env_steps_per_sec"] == pytest.approx(8 * 64 / 16.0)
+    assert out[TELEMETRY_PREFIX + "fetch_amortization"] == pytest.approx(64.0)
+    assert tele.snapshot()["counters"]["env_steps_total"] == 8 * 64
+    # exported on /metrics under the registered names
+    from sheeprl_tpu.diagnostics.metrics_server import render_prometheus
+
+    text = render_prometheus(tele.snapshot())
+    assert "sheeprl_env_steps_per_sec" in text
+    assert "sheeprl_fetch_amortization" in text
+    assert "sheeprl_env_steps_total 512" in text
+    # interval accumulators reset
+    clock.t += 1.0
+    again = tele.interval_metrics(512)
+    assert TELEMETRY_PREFIX + "env_steps_per_sec" not in again
+    tele.close()
+
+
 def test_unknown_device_kind_reports_no_mfu():
     clock = FakeClock()
     tele = Telemetry(_diag_cfg(), clock=clock)  # no peak override; CPU kind

@@ -123,6 +123,70 @@ def test_golden_trajectory_sync_vs_pipelined(executor):
     reference.close()
 
 
+def _golden_sharded_run(num_envs: int, envs_per_worker: int, steps: int, min_boundaries: int):
+    """Sync-vs-sharded-shm golden equality through the full wrapper stack
+    (episode stats, final_obs/final_info mask layout, autoreset boundaries)."""
+    cfg = _cfg(executor="shared_memory", num_envs=num_envs, envs_per_worker=envs_per_worker)
+    reference = vectorized_env(make_env_fns(_cfg(num_envs=num_envs), restartable=False), sync=True)
+    pipelined = pipelined_vector_env(cfg, make_env_fns(_cfg(num_envs=num_envs), restartable=False))
+    shm = pipelined.envs
+    assert isinstance(shm, SharedMemoryVectorEnv)
+    assert shm.envs_per_worker == envs_per_worker
+    assert shm.num_workers == -(-num_envs // envs_per_worker)
+
+    obs_ref, info_ref = reference.reset(seed=7)
+    obs_pipe, info_pipe = pipelined.reset(seed=7)
+    for k in obs_ref:
+        np.testing.assert_array_equal(obs_ref[k], obs_pipe[k])
+    _assert_same_tree(info_ref, info_pipe, "reset")
+
+    rng = np.random.default_rng(3)
+    boundaries = 0
+    for t in range(steps):
+        actions = rng.integers(0, 2, size=num_envs)
+        ref = reference.step(actions)
+        pipelined.step_async(actions)
+        got = pipelined.step_wait()
+        for k in ref[0]:
+            np.testing.assert_array_equal(ref[0][k], got[0][k], err_msg=f"step {t} obs[{k}]")
+        # rewards: float32 slab end-to-end — values identical to the float64
+        # reference under the float32 cast every loop applies anyway
+        assert got[1].dtype == np.float32
+        np.testing.assert_array_equal(np.asarray(ref[1], np.float32), got[1], err_msg=f"step {t} rewards")
+        for j, name in ((2, "terminated"), (3, "truncated")):
+            np.testing.assert_array_equal(ref[j], got[j], err_msg=f"step {t} {name}")
+        _assert_same_tree(ref[4], got[4], f"step{t}")
+        if "final_obs" in ref[4]:
+            boundaries += 1
+    assert boundaries >= min_boundaries, "the golden run must cross autoreset boundaries"
+    pipelined.close()
+    reference.close()
+
+
+def test_golden_trajectory_sharded_shm_multi_env_slabs():
+    """16 envs in 4-env worker slabs: one cmd/ack per worker, bit-identical
+    trajectories (including two SAME_STEP autoreset waves)."""
+    _golden_sharded_run(num_envs=16, envs_per_worker=4, steps=12, min_boundaries=2)
+
+
+@pytest.mark.slow
+def test_golden_trajectory_sharded_shm_64_envs():
+    """The acceptance-scale golden: 64 envs, envs_per_worker=16 (4 workers)."""
+    _golden_sharded_run(num_envs=64, envs_per_worker=16, steps=12, min_boundaries=2)
+
+
+def test_auto_envs_per_worker_heuristic():
+    from sheeprl_tpu.envs.executor import auto_envs_per_worker
+
+    cores = max(1, __import__("os").cpu_count() or 1)
+    assert auto_envs_per_worker(1) == 1
+    # one env per worker while workers fit the cores, then slabs grow
+    assert auto_envs_per_worker(cores) == 1
+    assert auto_envs_per_worker(cores * 8) == 8
+    n = cores * 8
+    assert -(-n // auto_envs_per_worker(n)) <= cores  # worker count capped at cores
+
+
 def test_pipelined_overlap_wall_clock():
     """N pipelined iterations (step_async -> host work -> step_wait) finish in
     measurably less wall-clock than the serialized sum: the sleep_ms env step
@@ -197,6 +261,57 @@ def test_shared_memory_worker_crash_recovers_via_restart_on_exception():
     envs.close()
 
 
+class _FlakySlabEnv(gym.Env):
+    """Same spaces as the Box-obs dummy, raises once on the second step."""
+
+    observation_space = gym.spaces.Box(-20, 20, (10,), np.float32)
+    action_space = gym.spaces.Discrete(2)
+
+    def __init__(self):
+        self.n = 0
+
+    def reset(self, seed=None, options=None):
+        return np.zeros(10, np.float32), {}
+
+    def step(self, action):
+        self.n += 1
+        if self.n == 2:
+            raise RuntimeError("transient sim crash")
+        return np.zeros(10, np.float32), 0.0, False, False, {}
+
+
+def _steady_fn():
+    return RestartOnException(
+        lambda: DiscreteDummyEnv(n_steps=1000, dict_obs_space=False), wait=0
+    )
+
+
+def _flaky_slab_fn():
+    return RestartOnException(_FlakySlabEnv, wait=0)
+
+
+def test_slab_worker_crash_recovers_via_restart_on_exception():
+    """A transient env crash INSIDE a multi-env slab is absorbed in-worker:
+    the crashing env restarts, its slab siblings keep their trajectories, and
+    the worker process survives."""
+    fns = [_steady_fn, _steady_fn, _flaky_slab_fn, _steady_fn]
+    envs = SharedMemoryVectorEnv(fns, envs_per_worker=2)  # worker 1 owns envs [2, 3]
+    assert envs.num_workers == 2
+    envs.reset(seed=0)
+    flagged = False
+    for _ in range(3):
+        obs, rewards, term, trunc, infos = envs.step(np.zeros(4, np.int64))
+        assert obs.shape[0] == 4
+        if "restart_on_exception" in infos:
+            flagged = True
+            mask = np.asarray(infos["restart_on_exception"])
+            assert bool(mask[2]) and not mask[[0, 1, 3]].any()
+            assert not term.any() and not trunc.any()
+    assert flagged, "the slab restart must surface info['restart_on_exception'][2]"
+    envs.step(np.zeros(4, np.int64))  # both workers still answer
+    envs.close()
+
+
 def test_step_async_misuse_raises():
     envs = PipelinedVectorEnv(
         gym.vector.SyncVectorEnv(
@@ -235,17 +350,41 @@ _COMMON_CLI = [
 
 
 def test_cli_smoke_ppo_shared_memory(run_cli):
-    run_cli(
-        "exp=ppo",
-        *_COMMON_CLI,
-        "diagnostics.trace.enabled=True",
-        "algo.rollout_steps=8",
-        "algo.per_rank_batch_size=4",
-        "algo.update_epochs=1",
-        "algo.mlp_keys.encoder=[state]",
-        "algo.cnn_keys.encoder=[]",
-    )
+    import jax
+
+    try:
+        run_cli(
+            "exp=ppo",
+            *_COMMON_CLI,
+            "env.envs_per_worker=2",  # one 2-env slab worker
+            "diagnostics.trace.enabled=True",
+            "diagnostics.compilation_cache_dir=logs/jit_cache",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+        )
+    finally:
+        # jax config is process-global: don't leave the suite writing caches
+        jax.config.update("jax_compilation_cache_dir", None)
     assert sorted(Path("logs").rglob("*.ckpt")), "no checkpoint written"
+
+    # env-throughput telemetry (ISSUE 7): the batched-inference loop must
+    # report env steps/s and a fetch amortization of exactly num_envs, and
+    # the persistent-compilation-cache satellite must journal its directory
+    import json as _json
+
+    journal = sorted(Path("logs").rglob("journal.jsonl"))[-1]
+    events = [_json.loads(line) for line in journal.read_text().splitlines() if line.strip()]
+    cache_events = [e for e in events if e.get("event") == "compilation_cache"]
+    assert cache_events and cache_events[0]["dir"] == "logs/jit_cache"
+    assert Path("logs/jit_cache").is_dir()
+    metric_rows = [e["metrics"] for e in events if e.get("event") == "metrics"]
+    env_sps = [m["Telemetry/env_steps_per_sec"] for m in metric_rows if "Telemetry/env_steps_per_sec" in m]
+    amort = [m["Telemetry/fetch_amortization"] for m in metric_rows if "Telemetry/fetch_amortization" in m]
+    assert env_sps and env_sps[-1] > 0
+    assert amort and amort[-1] == 2.0  # num_envs per blocking fetch
 
     # the split-phase spans must be visible in the Perfetto trace, one pair
     # per rollout step, and every emitted phase name must stay in the

@@ -5,6 +5,11 @@ protocol: a loop that issues ``step_async`` twice on the same env without a
 ``step_wait`` between deadlocks the thread executor and corrupts the shm
 executor's in-place buffers.  And the shm worker protocol's command bytes
 are a wire format — a second module re-declaring them can drift silently.
+Since the worker-sharding rework that format is PER WORKER (one
+``_CMD_STEP`` down / one ack up covers a whole env slab, ``_CMD_RESET``
+carries the slab's seed list), which makes a stray re-declaration even more
+dangerous: a module assuming the old per-env protocol would deadlock a slab
+worker mid-drain.
 
 Scoping decisions that keep the pass honest:
 

@@ -100,6 +100,54 @@ def measure_env_host(sleep_ms: float = 50.0, iters: int = 20, host_work_ms: floa
     }
 
 
+def measure_env_scale_host(num_envs_list=(4, 16, 64), sleep_ms: float = 0.5, iters: int = 15):
+    """Host-only many-env scaling line (ISSUE 7): the sharded shm executor's
+    ``env_steps_per_sec`` across env counts, no accelerator needed — isolates
+    the worker-sharding win (one command/ack per WORKER + batched copy-out)
+    from the device-link effects ``bench.py``'s ``env_scale`` stage adds.
+    The signal: steps/s grows with ``num_envs`` while the auto heuristic can
+    still add workers (one per core), then plateaus at cores/sleep_ms — the
+    plateau, not a collapse, is the point: the old one-process-per-env layout
+    degrades past the core count (scheduler thrash + per-env acks) instead of
+    plateauing."""
+    import numpy as np
+
+    from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+    from sheeprl_tpu.envs.executor import SharedMemoryVectorEnv
+
+    out = {
+        "experiment": "env_scale_host",
+        "sleep_ms": sleep_ms,
+        "iters": iters,
+        "num_envs": [],
+        "env_steps_per_sec": [],
+        "envs_per_worker": [],
+        "num_workers": [],
+    }
+    for n in num_envs_list:
+        fns = [
+            (lambda: DiscreteDummyEnv(n_steps=1_000_000, image_size=(3, 8, 8), vector_shape=(8,), sleep_ms=sleep_ms))
+            for _ in range(n)
+        ]
+        envs = SharedMemoryVectorEnv(fns)  # auto envs_per_worker heuristic
+        try:
+            envs.reset(seed=0)
+            actions = np.zeros(n, np.int64)
+            for _ in range(3):
+                envs.step(actions)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                envs.step(actions)
+            elapsed = time.perf_counter() - t0
+        finally:
+            envs.close()
+        out["num_envs"].append(int(n))
+        out["env_steps_per_sec"].append(round(n * iters / elapsed, 1))
+        out["envs_per_worker"].append(int(envs.envs_per_worker))
+        out["num_workers"].append(int(envs.num_workers))
+    return out
+
+
 PHASE_EXPERIMENTS = {
     # Phase isolation by config deltas vs the base (T=64, H=15, pixel obs):
     # the difference between base and each variant prices one phase.
@@ -220,9 +268,11 @@ def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
     phases = os.environ.get("PERF_PHASES", "0") == "1"
 
-    # env pipeline host-time split first: needs no accelerator, so it lands
-    # even when the probe below aborts the chip sections
+    # env pipeline host-time split + many-env scaling first: neither needs an
+    # accelerator, so both land even when the probe below aborts the chip
+    # sections
     print(json.dumps(measure_env_host()), flush=True)
+    print(json.dumps(measure_env_scale_host()), flush=True)
 
     # fail FAST on a dead tunnel instead of wedging inside the first blocking
     # fetch: this is the chip-study tool — unlike bench.py there is no useful

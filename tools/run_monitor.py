@@ -9,8 +9,9 @@ Two sources, same view:
   ``/metrics`` endpoint (``diagnostics.telemetry.http.enabled=True``) — works
   across machines without filesystem access.
 
-Shows run identity and state, the latest metric interval (reward, SPS,
-TFLOP/s, MFU, phase breakdown), an HBM/transfers panel (bytes in use vs
+Shows run identity and state, the latest metric interval (reward, SPS, env
+throughput — env-steps/s + fetch amortization — TFLOP/s, MFU, phase
+breakdown), an HBM/transfers panel (bytes in use vs
 peak, replay/RSS footprint, host-transfer + donation-miss + OOM counters)
 and recompile/divergence counters; with ``--follow`` it streams every new
 journal row as a compact line (``tools/journal_report.py --follow`` shares
@@ -131,6 +132,8 @@ def endpoint_status(url: str) -> str:
         parts.append(f"step {steps:g}")
     for key, label, fmt in (
         ("sheeprl_sps", "sps", "{:.0f}"),
+        ("sheeprl_env_steps_per_sec", "env-sps", "{:.0f}"),
+        ("sheeprl_fetch_amortization", "fetch-amort", "{:.0f}x"),
         ("sheeprl_tflops_per_sec", "tflops", "{:.2f}"),
         ("sheeprl_mfu", "mfu", "{:.1%}"),
     ):
