@@ -116,6 +116,29 @@ def check_configs(cfg: dotdict) -> None:
         raise ValueError(
             f"diagnostics.telemetry.watchdog.storm_threshold must be >= 1, got {storm_threshold!r}"
         )
+    # goodput watchdog knobs: >0-or-null, here AND in the GoodputMonitor
+    # constructor (direct entrypoint callers skip check_configs) —
+    # Event.wait(<=0) degenerates into a busy-spin, so it must never arm
+    goodput_cfg = (cfg.get("diagnostics") or {}).get("goodput") or {}
+    goodput_wd_cfg = goodput_cfg.get("watchdog") or {}
+    for knob in ("heartbeat_s", "stall_threshold_s"):
+        value = goodput_wd_cfg.get(knob)
+        if value is not None and float(value) <= 0:
+            raise ValueError(
+                f"diagnostics.goodput.watchdog.{knob} must be > 0 or null "
+                f"(null disables the watchdog), got {value!r}"
+            )
+    profile_cfg = goodput_cfg.get("profile") or {}
+    # validated only while the pillar can actually run: the remedy the error
+    # suggests (profile.enabled=False) must itself pass validation, and the
+    # enabled default must match the GoodputMonitor ctor's (opt-in: False)
+    if goodput_cfg.get("enabled", True) and profile_cfg.get("enabled", False):
+        max_ms = profile_cfg.get("max_ms")
+        if max_ms is not None and float(max_ms) < 10:
+            raise ValueError(
+                f"diagnostics.goodput.profile.max_ms must be >= 10 (the capture floor), "
+                f"got {max_ms!r}; set diagnostics.goodput.profile.enabled=False instead"
+            )
     learning_starts = cfg.algo.get("learning_starts")
     if learning_starts is not None and learning_starts < 0:
         raise ValueError("The `algo.learning_starts` parameter must be greater or equal to zero")

@@ -1,7 +1,7 @@
 """Run-health & observability subsystem.
 
-Five pillars behind one facade (ISSUE 1 tentpole + ISSUE 3 telemetry layer +
-ISSUE 4 memory layer):
+Six pillars behind one facade (ISSUE 1 tentpole + ISSUE 3 telemetry layer +
+ISSUE 4 memory layer + ISSUE 8 run-lifecycle layer):
 
 * :mod:`~sheeprl_tpu.diagnostics.journal` — crash-safe JSONL run journal
   (write-ahead metric/event log; makes TensorBoard archaeology and the
@@ -23,7 +23,15 @@ ISSUE 4 memory layer):
   ``diagnostics.transfers`` host-transfer guard around the instrumented
   dispatches, a first-dispatch donation/sharding audit, and OOM forensics
   journaled before a ``RESOURCE_EXHAUSTED`` takes the process down
-  (``tools/memory_report.py`` renders the tables).
+  (``tools/memory_report.py`` renders the tables);
+* :mod:`~sheeprl_tpu.diagnostics.goodput` — run lifecycle & goodput
+  (ISSUE 8): a run-state machine (``starting → compiling → training /
+  env_wait / checkpointing / stalled → ended``) driven by the hooks above, a
+  heartbeat stall watchdog journaling fsync'd ``stall`` forensics
+  (all-thread stacks, optional ``jax.profiler`` auto-capture), and the live
+  ``Telemetry/run_state`` / ``Telemetry/goodput`` /
+  ``Telemetry/time_to_first_step`` gauges (``tools/goodput_report.py``
+  groups a resumed run's ``version_N`` segments post-mortem).
 
 The facade is constructed once in ``cli.run_algorithm`` from the
 ``configs/diagnostics/`` group and attached to the :class:`Runtime`; training
@@ -41,7 +49,15 @@ import warnings
 from contextlib import contextmanager, nullcontext
 from typing import Any, Dict, Mapping, Optional
 
-from sheeprl_tpu.diagnostics.journal import JOURNAL_NAME, RunJournal, find_journal, iter_journal, read_journal
+from sheeprl_tpu.diagnostics.goodput import GoodputMonitor
+from sheeprl_tpu.diagnostics.journal import (
+    JOURNAL_NAME,
+    RunJournal,
+    collect_journals,
+    find_journal,
+    iter_journal,
+    read_journal,
+)
 from sheeprl_tpu.diagnostics.memory import MEMORY_EVENTS, MemoryMonitor, tree_bytes
 from sheeprl_tpu.diagnostics.sentinel import (
     DivergenceDetector,
@@ -56,6 +72,7 @@ from sheeprl_tpu.diagnostics.tracing import TRACE_NAME, NullTracer, PhaseTracer
 __all__ = [
     "Diagnostics",
     "DivergenceDetector",
+    "GoodputMonitor",
     "JOURNAL_NAME",
     "MEMORY_EVENTS",
     "MemoryMonitor",
@@ -68,6 +85,7 @@ __all__ = [
     "TRACE_NAME",
     "Telemetry",
     "build_diagnostics",
+    "collect_journals",
     "config_hash",
     "find_journal",
     "iter_journal",
@@ -149,6 +167,11 @@ class Diagnostics:
                         "will NOT run. Only the passive Telemetry/hbm_* gauges remain active.",
                         RuntimeWarning,
                     )
+        self.goodput: Optional[GoodputMonitor] = None
+        if self.enabled:
+            goodput = GoodputMonitor(cfg or {})
+            if goodput.enabled:
+                self.goodput = goodput
         self.journal: Optional[RunJournal] = None
         self.tracer = NullTracer()
         self.metrics_server = None
@@ -216,6 +239,29 @@ class Diagnostics:
             # opened on every rank: the transfer guard must protect every
             # process; journal writes no-op off rank 0 (journal is None there)
             self.memory.open(self._journal_event, self._journal_sync)
+        if self.goodput is not None and self._rank_zero:
+            # rank-0 only, like the journal: the state machine / watchdog
+            # describe THE run, and their output is journal + gauges
+            self.goodput.open(
+                self._goodput_event,
+                self._journal_sync,
+                telemetry=self.telemetry,
+                log_dir=self.log_dir,
+            )
+            if self.telemetry is None:
+                # warned HERE (rank-0, at open) rather than in the ctor: the
+                # gauges the warning is about only ever exist on this rank.
+                # The state machine still runs on span/interval hooks, but
+                # Telemetry/goodput + time_to_first_step need telemetry's
+                # train-span seconds and dispatch notifications — they will
+                # be OMITTED (never a false 0.0), which must not be a silent
+                # surprise
+                warnings.warn(
+                    "diagnostics.goodput.enabled=True but diagnostics.telemetry.enabled=False: "
+                    "Telemetry/goodput and Telemetry/time_to_first_step will be omitted "
+                    "(the run-state machine and stall watchdog still run on span/interval hooks).",
+                    RuntimeWarning,
+                )
         if self.telemetry is not None:
             self.telemetry.open(
                 self._journal_event,
@@ -226,6 +272,10 @@ class Diagnostics:
                     "role": self.role,
                 },
             )
+            if self.goodput is not None and self.goodput._opened:
+                # telemetry drives the compile/dispatch notifications (and
+                # hosts the stall-injection sleep) for the state machine
+                self.telemetry._goodput = self.goodput
             if self._rank_zero and self.telemetry.http_enabled:
                 self._start_metrics_server()
         return self
@@ -233,11 +283,15 @@ class Diagnostics:
     def _start_metrics_server(self) -> None:
         from sheeprl_tpu.diagnostics.metrics_server import MetricsServer
 
+        profile_fn = None
+        if self.goodput is not None and self.goodput._opened and self.goodput.profile_enabled:
+            profile_fn = self.goodput.capture_profile
         try:
             self.metrics_server = MetricsServer(
                 self._server_snapshot,
                 host=self.telemetry.http_host,
                 port=self.telemetry.http_port,
+                profile_fn=profile_fn,
             )
             host, port = self.metrics_server.start()
         except OSError as err:
@@ -259,6 +313,14 @@ class Diagnostics:
             for k, v in mem["info"].items():
                 if v is not None:
                     info.setdefault(k, v)
+        if self.goodput is not None and self.goodput._opened:
+            good = self.goodput.snapshot()
+            snap.setdefault("gauges", {}).update(good["gauges"])
+            snap.setdefault("counters", {}).update(good["counters"])
+            info = snap.setdefault("info", {})
+            for k, v in good["info"].items():
+                if v is not None:
+                    info.setdefault(k, v)
         if self.journal is not None and self.journal.last_write_t is not None:
             import time
 
@@ -268,6 +330,15 @@ class Diagnostics:
     def _journal_event(self, event: str, **fields: Any) -> None:
         if self.journal is not None:
             self.journal.write(event, **fields)
+
+    def _goodput_event(self, event: str, **fields: Any) -> None:
+        """Goodput emissions mirror into the journal AND (as instants) the
+        trace, so a Perfetto timeline shows state changes/stalls in place."""
+        self._journal_event(event, **fields)
+        if event == "state_change":
+            self.tracer.instant(f"state:{fields.get('state')}", prev=fields.get("prev"))
+        elif event in ("stall", "stall_end"):
+            self.tracer.instant(event)
 
     def _journal_sync(self) -> None:
         """Force journal bytes to disk NOW (OOM forensics: the record must
@@ -282,10 +353,22 @@ class Diagnostics:
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None
-        if self.telemetry is not None:
+        goodput_open = self.goodput is not None and self.goodput._opened
+        if goodput_open:
+            # close BEFORE summarizing: the ended-transition folds the live
+            # state tail (and any open stall) into the state_seconds totals
+            self.goodput.close()
+        if self.telemetry is not None or goodput_open:
+            # one closing summary event whether either (or both) layers ran —
+            # telemetry-off + goodput-on must not discard the state/stall
+            # accounting
             if self.journal is not None:
-                self.journal.write("telemetry_summary", **self.telemetry.summary())
-            self.telemetry.close()
+                summary = self.telemetry.summary() if self.telemetry is not None else {}
+                if goodput_open:
+                    summary.update(self.goodput.summary())
+                self.journal.write("telemetry_summary", **summary)
+            if self.telemetry is not None:
+                self.telemetry.close()
         if self.memory is not None and self.journal is not None:
             self.journal.write("memory_summary", **self.memory.summary())
         if self.journal is not None:
@@ -296,14 +379,20 @@ class Diagnostics:
     # -- tracing + phase accounting ----------------------------------------
     def span(self, name: str, **args: Any):
         """Phase span context manager: feeds the telemetry phase-attribution
-        accumulator and (when tracing is open) the Chrome trace."""
+        accumulator, the run-state machine and (when tracing is open) the
+        Chrome trace."""
         tracing = not isinstance(self.tracer, NullTracer)
-        if self.telemetry is None and not tracing:
+        # `_opened` (not just `is not None`): goodput is rank-0 only, and
+        # telemetry-off workers must not pay a generator per span for a no-op
+        goodput = self.goodput if (self.goodput is not None and self.goodput._opened) else None
+        if self.telemetry is None and not tracing and goodput is None:
             return nullcontext()
-        return self._span(name, args, tracing)
+        return self._span(name, args, tracing, goodput)
 
     @contextmanager
-    def _span(self, name: str, args: Dict[str, Any], tracing: bool):
+    def _span(self, name: str, args: Dict[str, Any], tracing: bool, goodput=None):
+        if goodput is not None:
+            goodput.note_span(name)
         token = self.telemetry.span_enter(name) if self.telemetry is not None else None
         try:
             if tracing:
@@ -348,6 +437,8 @@ class Diagnostics:
             extra.update(self.telemetry.interval_metrics(step))
         if self.memory is not None and self._rank_zero and self.log_dir is not None:
             extra.update(self.memory.interval_metrics())
+        if self.goodput is not None:
+            extra.update(self.goodput.interval_metrics())
         if not extra:
             return metrics
         merged = dict(metrics)

@@ -28,8 +28,9 @@ from __future__ import annotations
 import json
 import math
 import os
+import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 JOURNAL_NAME = "journal.jsonl"
 
@@ -85,47 +86,55 @@ class RunJournal:
         self._fp = open(self.path, "a", encoding="utf-8")
         self._count = 0
         self._closed = False
+        # the loop thread is no longer the only writer: the stall watchdog
+        # and the metrics-server HTTP threads journal concurrently, and an
+        # interleaved fp.write would corrupt the line framing
+        self._lock = threading.Lock()
         # wall-clock of the newest write: the /metrics endpoint exposes
         # now - last_write_t as sheeprl_journal_lag_seconds (stall detector)
         self.last_write_t: Optional[float] = None
 
     def write(self, event: str, **fields: Any) -> None:
-        if self._closed:
-            return
-        self.last_write_t = time.time()
         record: Dict[str, Any] = {"t": round(time.time(), 3), "event": str(event)}
         record.update(_sanitize(fields))
-        self._fp.write(json.dumps(record, separators=(",", ":")) + "\n")
-        self._fp.flush()
-        self._count += 1
-        if self._fsync_every and self._count % self._fsync_every == 0:
-            try:
-                os.fsync(self._fp.fileno())
-            except OSError:  # pragma: no cover - exotic filesystems
-                pass
+        line = json.dumps(record, separators=(",", ":")) + "\n"
+        with self._lock:
+            if self._closed:
+                return
+            self.last_write_t = time.time()
+            self._fp.write(line)
+            self._fp.flush()
+            self._count += 1
+            if self._fsync_every and self._count % self._fsync_every == 0:
+                try:
+                    os.fsync(self._fp.fileno())
+                except OSError:  # pragma: no cover - exotic filesystems
+                    pass
 
     def sync(self) -> None:
         """Force buffered events to disk regardless of the fsync cadence —
-        the OOM-forensics path calls this so the post-mortem record survives
-        the process dying immediately afterwards."""
-        if self._closed:
-            return
-        try:
-            self._fp.flush()
-            os.fsync(self._fp.fileno())
-        except (OSError, ValueError):  # pragma: no cover
-            pass
+        the OOM-forensics and stall paths call this so the post-mortem record
+        survives the process dying immediately afterwards."""
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                self._fp.flush()
+                os.fsync(self._fp.fileno())
+            except (OSError, ValueError):  # pragma: no cover
+                pass
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        try:
-            self._fp.flush()
-            os.fsync(self._fp.fileno())
-        except (OSError, ValueError):  # pragma: no cover
-            pass
-        self._fp.close()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._fp.flush()
+                os.fsync(self._fp.fileno())
+            except (OSError, ValueError):  # pragma: no cover
+                pass
+            self._fp.close()
 
 
 def iter_journal(path: str) -> Iterator[Dict[str, Any]]:
@@ -169,3 +178,27 @@ def find_journal(run_path: str) -> Optional[str]:
     if not candidates:
         return None
     return max(candidates, key=os.path.getmtime)
+
+
+def collect_journals(paths: Sequence[str]) -> List[str]:
+    """Expand files/run dirs into ALL journal files below them (sorted,
+    de-duplicated) — unlike :func:`find_journal`, every segment of a resumed
+    run is kept: ``tools/goodput_report.py`` groups the ``version_N``
+    siblings into one logical run, and ``tools/trace_report.py`` reads them
+    for the run-state overlay."""
+    out: List[str] = []
+    for path in paths:
+        # normalized so the same journal reached via different spellings
+        # (explicit file arg vs. a dir walk) de-duplicates to one entry
+        if os.path.isfile(path):
+            out.append(os.path.abspath(path))
+        elif os.path.isdir(path):
+            for root, _, files in os.walk(path):
+                if JOURNAL_NAME in files:
+                    out.append(os.path.abspath(os.path.join(root, JOURNAL_NAME)))
+    seen, unique = set(), []
+    for path in sorted(out):
+        if path not in seen:
+            seen.add(path)
+            unique.append(path)
+    return unique

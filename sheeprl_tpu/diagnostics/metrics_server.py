@@ -1,4 +1,5 @@
-"""Rank-0 live metrics endpoint: ``/metrics`` (Prometheus text) + ``/healthz``.
+"""Rank-0 live metrics endpoint: ``/metrics`` (Prometheus text) + ``/healthz``
+(+ on-demand ``/profile`` jax.profiler captures when the goodput layer is on).
 
 A stdlib ``ThreadingHTTPServer`` on a daemon thread — no new dependencies —
 serving the telemetry snapshot so external scrapers (Prometheus, or the
@@ -111,10 +112,23 @@ def render_prometheus(snapshot: Mapping[str, Any]) -> str:
 
 
 class MetricsServer:
-    """Background HTTP server bound to ``host:port`` (0 = ephemeral)."""
+    """Background HTTP server bound to ``host:port`` (0 = ephemeral).
 
-    def __init__(self, snapshot_fn: Callable[[], Dict[str, Any]], host: str = "127.0.0.1", port: int = 0):
+    ``profile_fn`` (optional, from the goodput layer) serves on-demand
+    ``jax.profiler`` captures at ``GET /profile[?ms=N]`` — the handler thread
+    blocks for the capture window, never the training loop; the journal
+    records every capture as a ``profile_capture`` event.
+    """
+
+    def __init__(
+        self,
+        snapshot_fn: Callable[[], Dict[str, Any]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        profile_fn: Optional[Callable[[Optional[float]], Dict[str, Any]]] = None,
+    ):
         self._snapshot_fn = snapshot_fn
+        self._profile_fn = profile_fn
         self._host = host
         self._port = int(port)
         self._server: Optional[ThreadingHTTPServer] = None
@@ -122,18 +136,33 @@ class MetricsServer:
 
     def start(self) -> Tuple[str, int]:
         snapshot_fn = self._snapshot_fn
+        profile_fn = self._profile_fn
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt: str, *args: Any) -> None:  # silence stderr spam
                 pass
 
             def do_GET(self) -> None:  # noqa: N802 - stdlib API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 try:
                     if path == "/metrics":
                         body = render_prometheus(snapshot_fn()).encode()
                         self.send_response(200)
                         self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                    elif path == "/profile" and profile_fn is not None:
+                        from urllib.parse import parse_qs
+
+                        ms: Optional[float] = None
+                        for value in parse_qs(query).get("ms", []):
+                            try:
+                                ms = float(value)
+                            except ValueError:
+                                pass
+                        result = profile_fn(ms)
+                        body = json.dumps(result).encode()
+                        # busy = retryable contention, not a client error
+                        self.send_response(200 if result.get("status") != "failed" else 500)
+                        self.send_header("Content-Type", "application/json")
                     elif path == "/healthz":
                         snap = snapshot_fn()
                         body = json.dumps(

@@ -201,6 +201,23 @@ def format_event_line(event: Dict[str, Any]) -> str:
             parts.append(f"recompiles {recompiles:g}")
         return f"[{clock}] {kind:<12s} " + "  ".join(parts)
     payload = {k: v for k, v in event.items() if k not in ("t", "event")}
+    if kind == "state_change":
+        return f"[{clock}] {kind:<12s} {payload.get('prev')} -> {payload.get('state')}"
+    if kind == "stall":
+        # `stacks` is a multi-KB forensics blob — never dump it on a tail line
+        return (
+            f"[{clock}] {'!! STALL':<12s} no progress for {payload.get('idle_s')}s "
+            f"(threshold {payload.get('threshold_s')}s, was {payload.get('last_state')}; "
+            "thread stacks in the journal)"
+        )
+    if kind == "stall_end":
+        return (
+            f"[{clock}] {kind:<12s} recovered after {payload.get('stalled_s')}s "
+            f"-> {payload.get('state')}"
+        )
+    if kind == "profile_capture":
+        where = f" -> {payload.get('dir')}" if payload.get("dir") else ""
+        return f"[{clock}] {kind:<12s} {payload.get('status')}{where}"
     if kind == "recompile":
         diff = payload.get("diff") or []
         head = "; ".join(str(d) for d in diff[:3])
@@ -269,8 +286,53 @@ def status_block(events: List[Dict[str, Any]]) -> str:
     n_ckpt = sum(1 for e in events if e.get("event") == "checkpoint")
     lines.append(f"events  {len(events)} total · {len(metrics_events)} intervals · "
                  f"{n_ckpt} checkpoints · {n_rec} recompiles · {n_div} divergences")
+    lines.extend(goodput_status_lines(events, live=run_end is None))
     lines.extend(memory_status_lines(events))
     return "\n".join(lines)
+
+
+def goodput_status_lines(events: List[Dict[str, Any]], live: bool = True) -> List[str]:
+    """The run-state / goodput / stall panel (run_monitor + goodput_report
+    share it).  ``live=False`` suppresses the ``!! STALLED`` banner — a
+    post-mortem over a killed-while-stalled journal states the fact in the
+    stall counters instead of shouting about a run that no longer exists.
+    Empty when the run journaled no goodput telemetry (pre-ISSUE-8 runs)."""
+    from sheeprl_tpu.diagnostics.goodput import journal_run_state, stalled_seconds
+
+    metrics_events = [e for e in events if e.get("event") == "metrics"]
+    # only render when the goodput layer actually ran: run_start/run_end alone
+    # would map to a state, and a pre-ISSUE-8 journal must not grow a panel
+    # implying the layer was active
+    has_goodput = any(
+        e.get("event") in ("state_change", "stall", "stall_end") for e in events
+    ) or any("Telemetry/run_state" in (e.get("metrics") or {}) for e in metrics_events)
+    if not has_goodput:
+        return []
+    freshest = journal_run_state(events)
+    last = (metrics_events[-1].get("metrics") or {}) if metrics_events else {}
+    lines: List[str] = []
+    if freshest is not None:
+        parts = [f"run-state {freshest[1]}"]
+        goodput = last.get("Telemetry/goodput")
+        if isinstance(goodput, (int, float)):
+            parts.append(f"goodput {goodput:.1%}")
+        ttfs = last.get("Telemetry/time_to_first_step")
+        if isinstance(ttfs, (int, float)):
+            parts.append(f"first step after {ttfs:.1f}s")
+        lines.append("goodput " + " · ".join(parts))
+    n_stalls = sum(1 for e in events if e.get("event") == "stall")
+    if n_stalls:
+        n_profiles = sum(
+            1 for e in events if e.get("event") == "profile_capture" and e.get("status") == "ok"
+        )
+        stall_line = f"stalls  {n_stalls} · {stalled_seconds(events):.1f}s stalled"
+        if n_profiles:
+            stall_line += f" · {n_profiles} profile capture{'s' if n_profiles != 1 else ''}"
+        lines.append(stall_line)
+    if live and freshest is not None and freshest[1] == "stalled":
+        age = time.time() - freshest[0]
+        lines.append(f"!! STALLED — no progress journaled for {max(0.0, age):.0f}s")
+    return lines
 
 
 def memory_status_lines(events: List[Dict[str, Any]]) -> List[str]:

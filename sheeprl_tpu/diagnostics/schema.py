@@ -51,6 +51,10 @@ EVENT_KINDS: Dict[str, str] = {
     "host_transfer": "a transfer-guard trip (device<->host sync) with provenance",
     "oom": "RESOURCE_EXHAUSTED forensics: full memory snapshot, fsync'd before re-raise",
     "memory_summary": "closing memory totals (peaks, guard trips, donation misses)",
+    "state_change": "run-state machine transition (steady states at first entry only; stall transitions always)",
+    "stall": "watchdog: no progress for stall_threshold_s — all-thread stacks, last state, idle seconds (fsync'd)",
+    "stall_end": "the stalled run made progress again (seconds stalled, restored state)",
+    "profile_capture": "auto (on stall) or on-demand (/profile) jax.profiler capture: status ok/busy/failed + directory",
     "run_end": "completed / halted / aborted — absent after a kill",
 }
 
@@ -87,6 +91,10 @@ METRICS: Dict[str, str] = {
     "sheeprl_host_transfers_total": "transfer-guard trips journaled",
     "sheeprl_donation_miss_leaves_total": "leaves that missed a declared donation",
     "sheeprl_oom_events_total": "RESOURCE_EXHAUSTED events journaled",
+    # goodput counters (GoodputMonitor.snapshot()["counters"])
+    "sheeprl_stalls_total": "stall-watchdog firings (no progress for stall_threshold_s)",
+    "sheeprl_stalled_seconds_total": "cumulative seconds spent in the stalled state",
+    "sheeprl_profile_captures_total": "successful jax.profiler captures (auto on stall + /profile)",
     # interval gauges (Telemetry/... keys, prefix-stripped and sanitized)
     "sheeprl_mfu": "model FLOPs utilization vs the device-kind peak",
     "sheeprl_tflops_per_sec": "achieved TFLOP/s over the last interval",
@@ -101,6 +109,10 @@ METRICS: Dict[str, str] = {
     "sheeprl_phase_pct_fetch": "interval wall-clock share: metric/buffer fetch",
     "sheeprl_phase_pct_other": "interval wall-clock share: other instrumented spans",
     "sheeprl_phase_pct_idle": "interval wall-clock share: un-instrumented host time",
+    # goodput gauges (run lifecycle layer, prefix-stripped)
+    "sheeprl_run_state": "run-state machine index into goodput.STATES (5 = stalled)",
+    "sheeprl_goodput": "cumulative productive share since open: train-span seconds / wall seconds",
+    "sheeprl_time_to_first_step": "seconds from diagnostics open to the first completed train dispatch",
     # memory gauges (Telemetry/hbm_* etc., prefix-stripped)
     "sheeprl_hbm_bytes_in_use": "per-device HBM bytes in use (max over devices)",
     "sheeprl_hbm_peak_bytes": "per-device HBM peak bytes (max over devices)",

@@ -242,6 +242,11 @@ class _Instrumented:
             if self._seen:
                 tele._watchdog_observe(self, sig, args, kwargs)
             self._seen.add(sig)
+            if tele._goodput is not None:
+                # a never-seen signature is exactly the condition under which
+                # jit compiles: flip the run state to `compiling` before the
+                # (potentially minutes-long) compile starts
+                tele._goodput.note_compile_start(self.name)
         if self._use_aot:
             compiled = self._compiled.get(sig)
             if compiled is None:
@@ -383,6 +388,9 @@ class Telemetry:
         # the facade attaches the MemoryMonitor here so instrumented
         # dispatches pick up the transfer guard / audits / OOM forensics
         self._memory = None
+        # ... and the (rank-0, opened) GoodputMonitor so compiles/dispatches
+        # drive the run-state machine and feed the stall watchdog
+        self._goodput = None
         self._lock = threading.Lock()
         self._journal_fn: Optional[Callable[..., None]] = None
         self._span_stack = threading.local()
@@ -472,6 +480,11 @@ class Telemetry:
                 self._train_flops_total += inst.flops_per_call
             if inst.kind == "rollout":
                 self._rollout_calls_interval += 1
+        if self._goodput is not None:
+            # outside the lock on purpose: the stall fault injection sleeps
+            # in this notification, and the watchdog thread must be able to
+            # take its own lock (and read counters here) meanwhile
+            self._goodput.note_dispatch(inst.name, inst.kind)
 
     def note_env_steps(self, n: int) -> None:
         """Count ``n`` environment steps (loops call it once per vector step
@@ -529,6 +542,13 @@ class Telemetry:
     def count_sentinel_event(self, n: int = 1) -> None:
         with self._lock:
             self._sentinel_events += int(n)
+
+    def train_seconds(self) -> float:
+        """Cumulative self-time of the ``train`` spans — the exact numerator
+        of the goodput gauge (includes any compile that ran inside a train
+        span; the state machine's ``state_seconds`` splits `compiling` out)."""
+        with self._lock:
+            return self._phase_total.get("train", 0.0)
 
     # -- phase spans -------------------------------------------------------
     def span(self, name: str):

@@ -65,6 +65,30 @@ _TIER1_DEFERRED_TO_CI = {
     "tests/test_algos/test_algos.py::test_p2e_dv3_exploration[1-continuous_dummy]",
     "tests/test_algos/test_algos.py::test_dreamer_v3[1-multidiscrete_dummy]",
     "tests/test_data/test_device_buffer.py::test_dv1_dv2_e2e_with_device_buffer[dreamer_v2]",
+    # PR 7 (goodput observability) added ~60s of tier-1 tests (state-machine/
+    # watchdog units + the two CLI acceptance e2es: the injected-stall drill
+    # and the SIGKILL-then-resume killed-segment run) and the uncapped suite
+    # measured 867s — defer another ~117s of redundant heavy siblings
+    # (--durations=40): bf16-true e2e keeps the bf16-mixed e2e + the
+    # bf16-compute HLO check as tier-1 representatives; the jepa training e2e
+    # keeps test_jepa_evaluate_roundtrip (tiny jepa trained through the real
+    # entrypoint, then evaluated); dv3 long-sequences keeps the episode-buffer
+    # boundary units + the async-pipeline autoreset goldens + dv3[1-discrete];
+    # dv2 use_continues and the dv1/dv2 continuous variants keep their
+    # discrete siblings (continuous imagination stays via
+    # test_dreamer_v3[1-continuous_dummy]).
+    "tests/test_parallel/test_precision.py::test_dreamer_v3_bf16_e2e[bf16-true]",
+    "tests/test_algos/test_algos.py::test_dreamer_v3_jepa[1]",
+    "tests/test_algos/test_algos.py::test_dreamer_v3_long_sequences_with_mid_episode_dones[1]",
+    "tests/test_algos/test_algos.py::test_dreamer_v2_use_continues[1]",
+    "tests/test_algos/test_algos.py::test_dreamer_v2[1-continuous_dummy]",
+    "tests/test_algos/test_algos.py::test_dreamer_v1[1-continuous_dummy]",
+    # ... and the dv3 resume e2e (30s): checkpoint-resume through the real
+    # CLI stays tier-1 via test_goodput's SIGKILL-then-resume killed-segment
+    # e2e (which also asserts the resumed segment trains and completes);
+    # dreamer-specific resume-state restoration stays covered in the CI e2e
+    # suite.
+    "tests/test_algos/test_algos.py::test_dreamer_v3_resume[1]",
 }
 
 

@@ -16,9 +16,12 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
   sharding-HLO checks, and the diagnostics suite
   (`tests/test_diagnostics/`: journal/sentinel/tracing plus
   `test_telemetry.py` — recompile watchdog, MFU/phase math, /metrics
-  endpoint, trace merge, the telemetry CLI e2e — and `test_memory.py` —
+  endpoint, trace merge, the telemetry CLI e2e — `test_memory.py` —
   footprint math, transfer guard, donation audit, OOM forensics,
-  memory_report rendering), plus `tests/test_tools/test_lint.py` (the
+  memory_report rendering — and `test_goodput.py` — run-state machine,
+  stall watchdog exactly-once + recovery paths, /profile capture smoke,
+  segment accounting, the injected-stall CLI drill and the
+  SIGKILL-then-resume killed-segment e2e), plus `tests/test_tools/test_lint.py` (the
   static-analysis framework itself).  The suite is preceded by the full
   `tools/sheeprl_lint.py` run (all pass families: INS instrumentation/
   donation wiring, JIT traced-body purity, CFG config contracts, JRN

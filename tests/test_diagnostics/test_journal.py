@@ -118,6 +118,34 @@ def test_disabled_facade_is_inert(tmp_path):
     assert list(os.listdir(tmp_path)) == []
 
 
+def test_concurrent_writers_never_interleave_lines(tmp_path):
+    """The stall watchdog and the metrics-server HTTP threads write
+    concurrently with the loop thread (ISSUE 8): every line must stay intact
+    JSON and every event must land exactly once."""
+    import threading
+
+    path = tmp_path / "journal.jsonl"
+    journal = RunJournal(str(path))
+    n_threads, n_events = 4, 200
+
+    def writer(thread_id):
+        for i in range(n_events):
+            journal.write("metrics", step=thread_id * n_events + i, metrics={"who": thread_id})
+            if i % 50 == 0:
+                journal.sync()  # the stall path syncs from its own thread
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    journal.close()
+    raw_lines = [l for l in path.read_text().splitlines() if l]
+    events = [json.loads(line) for line in raw_lines]  # every line parses whole
+    assert len(events) == n_threads * n_events
+    assert sorted(e["step"] for e in events) == list(range(n_threads * n_events))
+
+
 def test_find_journal_walks_run_dirs(tmp_path):
     version = tmp_path / "run" / "version_0"
     version.mkdir(parents=True)

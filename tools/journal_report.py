@@ -13,9 +13,12 @@ Usage:
     python tools/journal_report.py <run dir> --follow      # live tail
 
 Accepts a journal file, a ``version_N`` directory, or any run-dir ancestor
-(the newest journal below wins).  ``--follow`` streams every journal row —
-including the live ``Telemetry/*`` gauges — as the compact one-line format
-shared with ``tools/run_monitor.py``, until the run ends or Ctrl-C.
+(the newest journal below wins — for ALL segments of a resumed run, use
+``tools/goodput_report.py``, which groups the ``version_N`` siblings with
+killed-segment detection and time-to-recover).  ``--follow`` streams every
+journal row — including the live ``Telemetry/*`` gauges and the
+``state_change``/``stall`` run-lifecycle events — as the compact one-line
+format shared with ``tools/run_monitor.py``, until the run ends or Ctrl-C.
 """
 
 from __future__ import annotations

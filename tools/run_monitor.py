@@ -11,12 +11,16 @@ Two sources, same view:
 
 Shows run identity and state, the latest metric interval (reward, SPS, env
 throughput — env-steps/s + fetch amortization — TFLOP/s, MFU, phase
-breakdown), an HBM/transfers panel (bytes in use vs
+breakdown), the run-state / goodput panel (state machine position, the
+cumulative goodput gauge, stall counters — with a ``!! STALLED`` banner
+while the watchdog has the run marked stalled, in BOTH modes), an
+HBM/transfers panel (bytes in use vs
 peak, replay/RSS footprint, host-transfer + donation-miss + OOM counters)
 and recompile/divergence counters; with ``--follow`` it streams every new
 journal row as a compact line (``tools/journal_report.py --follow`` shares
 this exact formatting; ``tools/memory_report.py`` renders the full footprint
-and sharding tables).
+and sharding tables; ``tools/goodput_report.py`` the segment-aware
+post-mortem view, banner suppressed).
 
 Usage:
     python tools/run_monitor.py logs/runs/ppo/CartPole-v1/<run>/
@@ -37,6 +41,7 @@ from typing import Any, Dict, Iterator, List, Optional
 # runnable straight from a checkout: tools/ is not a package
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from sheeprl_tpu.diagnostics.goodput import STATES  # noqa: E402
 from sheeprl_tpu.diagnostics.journal import find_journal  # noqa: E402
 from sheeprl_tpu.diagnostics.report import format_bytes, format_event_line, status_block  # noqa: E402
 
@@ -123,9 +128,20 @@ def endpoint_status(url: str) -> str:
         )
     lag = metrics.get("sheeprl_journal_lag_seconds")
     state = "serving"
+    run_state = metrics.get("sheeprl_run_state")
+    stalled = False
+    if run_state is not None and 0 <= int(run_state) < len(STATES):
+        state_name = STATES[int(run_state)]
+        stalled = state_name == "stalled"
+        state += f" · run-state {state_name}"
     if lag is not None:
         state += f" (last journal write {lag:.0f}s ago)"
     lines.append(f"state   {state}")
+    if stalled:
+        banner = "!! STALLED — the watchdog sees no training progress"
+        if lag is not None:
+            banner += f" (journal lag {lag:.0f}s)"
+        lines.append(banner)
     parts = []
     steps = metrics.get("sheeprl_policy_steps_total")
     if steps is not None:
@@ -136,6 +152,8 @@ def endpoint_status(url: str) -> str:
         ("sheeprl_fetch_amortization", "fetch-amort", "{:.0f}x"),
         ("sheeprl_tflops_per_sec", "tflops", "{:.2f}"),
         ("sheeprl_mfu", "mfu", "{:.1%}"),
+        ("sheeprl_goodput", "goodput", "{:.1%}"),
+        ("sheeprl_time_to_first_step", "first-step", "{:.1f}s"),
     ):
         value = metrics.get(key)
         if value is not None:
@@ -174,6 +192,8 @@ def endpoint_status(url: str) -> str:
         ("sheeprl_recompile_storms_total", "storms"),
         ("sheeprl_sentinel_events_total", "sentinel events"),
         ("sheeprl_backend_compiles_total", "compiles"),
+        ("sheeprl_stalls_total", "stalls"),
+        ("sheeprl_stalled_seconds_total", "stalled s"),
         ("sheeprl_host_transfers_total", "host transfers"),
         ("sheeprl_donation_miss_leaves_total", "donation-miss leaves"),
         ("sheeprl_oom_events_total", "ooms"),

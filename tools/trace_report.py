@@ -8,9 +8,17 @@ monotonic ``ts`` values on the Unix epoch, and names the run id, rank and role
 
 * merge traces written by different processes — a decoupled player + trainer
   pair, or the per-rank ``trace_rank{N}.json`` files of a multihost run — into
-  ONE Chrome/Perfetto-loadable timeline (``--out merged.json``), and
+  ONE Chrome/Perfetto-loadable timeline (``--out merged.json``),
 * print the per-phase wall-clock table (count / total / mean / share per
-  role) that PERF.md §3 used to hand-compute from isolated runs.
+  role) that PERF.md §3 used to hand-compute from isolated runs, and
+* overlay the run-state machine (ISSUE 8) as its own track: when a *run dir*
+  argument also contains a ``journal.jsonl``, its ``state_change`` /
+  ``stall`` / ``stall_end`` events and per-interval ``Telemetry/run_state``
+  gauges become state spans on the same absolute timeline (journal ``t`` is
+  the same Unix clock the trace anchors use), so "the pool stalled HERE"
+  lines up against the phase spans.  Stalled time is drawn from the
+  ``stall``/``stall_end`` bounds only — exactly one span per stall — and the
+  overlay never feeds the phase table.
 
 Accepts trace files, run directories (all ``trace*.json`` below are taken,
 rotated ``.1``/``.2`` generations included) and crash-truncated files (the
@@ -34,6 +42,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 # runnable straight from a checkout: tools/ is not a package
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.diagnostics.goodput import STATES  # noqa: E402
+from sheeprl_tpu.diagnostics.journal import collect_journals, read_journal  # noqa: E402
 
 
 def load_trace(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
@@ -150,9 +161,89 @@ def merge_traces(paths: List[str]) -> Tuple[List[Dict[str, Any]], List[Dict[str,
     return preamble + merged, sources
 
 
+def run_state_overlay(
+    journal_events: List[Dict[str, Any]], pid: int, label: str = "run_state"
+) -> List[Dict[str, Any]]:
+    """Build run-state spans (with ``abs_us``, un-rebased) from one journal.
+
+    Steady-state spans come from the union of ``state_change`` boundaries and
+    the per-interval ``Telemetry/run_state`` gauge points (flood control
+    journals steady states at FIRST entry only, so the gauges are what
+    segments a long steady stretch); consecutive same-state points coalesce.
+    Stalled time is drawn ONLY from the ``stall``/``stall_end`` bounds —
+    exactly one span per stall; counting the ``state_change(stalled)``
+    boundary too would double-draw it.  A final pre-kill state gets a span to
+    the journal's last event, floored at 1 µs so it stays visible/parseable.
+    """
+    boundaries: List[Tuple[float, Optional[str]]] = []
+    stalls: List[Tuple[float, Optional[float]]] = []
+    last_t: Optional[float] = None
+    open_stall: Optional[float] = None
+    for event in journal_events:
+        t = event.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        last_t = t if last_t is None else max(last_t, t)
+        kind = event.get("event")
+        if kind == "run_start":
+            boundaries.append((t, "starting"))
+        elif kind == "state_change":
+            state = event.get("state")
+            boundaries.append((t, None if state == "stalled" else str(state)))
+        elif kind == "stall":
+            boundaries.append((t, None))
+            open_stall = t
+        elif kind == "stall_end":
+            boundaries.append((t, str(event.get("state") or "training")))
+            if open_stall is not None:
+                stalls.append((open_stall, t))
+                open_stall = None
+        elif kind == "run_end":
+            boundaries.append((t, None))
+        elif kind == "metrics":
+            gauge = (event.get("metrics") or {}).get("Telemetry/run_state")
+            if isinstance(gauge, (int, float)) and 0 <= int(gauge) < len(STATES):
+                state = STATES[int(gauge)]
+                boundaries.append((t, None if state == "stalled" else state))
+    if open_stall is not None:  # killed while stalled: span to the last event
+        stalls.append((open_stall, None))
+    if not boundaries or last_t is None:
+        return []
+
+    def span(name: str, t_from: float, t_to: float) -> Dict[str, Any]:
+        return {
+            "name": name,
+            "cat": "run_state",  # keeps the overlay out of phase_table
+            "ph": "X",
+            "abs_us": int(t_from * 1e6),
+            "dur": max(1, int((t_to - t_from) * 1e6)),
+            "pid": pid,
+            "tid": 0,
+            "args": {"overlay": label},
+        }
+
+    out: List[Dict[str, Any]] = []
+    boundaries.sort(key=lambda b: b[0])
+    cur_state: Optional[str] = None
+    cur_t = boundaries[0][0]
+    for t, state in boundaries:
+        if state == cur_state:
+            continue
+        if cur_state is not None and cur_state != "ended":
+            out.append(span(cur_state, cur_t, t))
+        cur_state, cur_t = state, t
+    if cur_state is not None and cur_state != "ended":
+        out.append(span(cur_state, cur_t, max(last_t, cur_t)))
+    for t_from, t_to in stalls:
+        out.append(span("stalled", t_from, t_to if t_to is not None else max(last_t, t_from)))
+    return out
+
+
 def phase_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
-    """Per (role, phase) wall-clock aggregation over merged span events."""
-    spans = [e for e in events if e.get("ph") == "X"]
+    """Per (role, phase) wall-clock aggregation over merged span events (the
+    run-state overlay track is excluded — a `stalled` overlay span is not a
+    host phase and would double-count against the stall accounting)."""
+    spans = [e for e in events if e.get("ph") == "X" and e.get("cat") != "run_state"]
     if not spans:
         return []
     stats: Dict[Tuple[str, str], Dict[str, float]] = {}
@@ -201,6 +292,11 @@ def main() -> int:
     parser.add_argument("paths", nargs="+", help="trace files and/or run dirs")
     parser.add_argument("--out", metavar="MERGED", help="write the merged Chrome trace to MERGED")
     parser.add_argument("--json", action="store_true", help="print the per-phase table as JSON")
+    parser.add_argument(
+        "--no-state-overlay",
+        action="store_true",
+        help="skip the run-state journal overlay track on the merged timeline",
+    )
     args = parser.parse_args()
 
     files = collect_trace_files(args.paths)
@@ -210,13 +306,50 @@ def main() -> int:
     merged, sources = merge_traces(files)
     rows = phase_table(merged)
 
+    # run-state overlay: journals under run-dir args only (file args are
+    # traces); each journal gets its own track on the merged timeline
+    overlay_info: List[Dict[str, Any]] = []
+    if merged and not args.no_state_overlay:
+        spans = [e for e in merged if "abs_us" in e]
+        t0 = (spans[0]["abs_us"] - spans[0]["ts"]) if spans else 0
+        journals = collect_journals([p for p in args.paths if os.path.isdir(p)])
+        for pid, journal_path in enumerate(journals, start=len(sources)):
+            segment = os.path.basename(os.path.dirname(os.path.abspath(journal_path)))
+            track = run_state_overlay(read_journal(journal_path), pid, label=segment)
+            if not track:
+                continue
+            for event in track:
+                event["ts"] = event["abs_us"] - t0
+            merged.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {"name": f"run_state {segment}"},
+                }
+            )
+            merged.extend(track)
+            overlay_info.append(
+                {
+                    "journal": journal_path,
+                    "n_state_spans": sum(1 for e in track if e["name"] != "stalled"),
+                    "n_stall_spans": sum(1 for e in track if e["name"] == "stalled"),
+                }
+            )
+
     if args.json:
-        print(json.dumps({"sources": sources, "phases": rows}, indent=2))
+        print(json.dumps({"sources": sources, "phases": rows, "run_state_overlay": overlay_info}, indent=2))
     else:
         for src in sources:
             print(
                 f"source: {src['path']}  role={src['role']} rank={src['rank']} "
                 f"({src['n_events']} events)"
+            )
+        for info in overlay_info:
+            print(
+                f"overlay: {info['journal']}  ({info['n_state_spans']} state spans, "
+                f"{info['n_stall_spans']} stall spans)"
             )
         print()
         print(format_phase_table(rows))
