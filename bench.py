@@ -191,7 +191,7 @@ def measure_compute(
         key, sub = jax.random.split(key)
         params, opt_states, moments_state, metrics = train_step(
             params, opt_states, moments_state, batch, sub, tau
-        )
+        )[:4]
     _ = np.asarray(metrics)  # warmup barrier: fetch real values
 
     # Timing discipline (VERDICT r1: a dispatch-only measurement implied
@@ -205,7 +205,7 @@ def measure_compute(
         key, sub = jax.random.split(key)
         params, opt_states, moments_state, metrics = train_step(
             params, opt_states, moments_state, batch, sub, tau
-        )
+        )[:4]
     final_metrics = np.asarray(metrics)
     elapsed = time.perf_counter() - t0
     assert np.isfinite(final_metrics).all()
@@ -387,7 +387,7 @@ def measure_e2e(
                 k_train, sub = jax.random.split(k_train)
                 params, opt_states, moments_state, metrics = train_step(
                     params, opt_states, moments_state, batch, sub, jnp.float32(0.02)
-                )
+                )[:4]
 
         if pipelined:
             with tele.span("env_wait"):
@@ -493,7 +493,7 @@ def measure_env_overlap(
             envs.step_async(actions)
         params, opt_states, moments_state, metrics = train_step(
             params, opt_states, moments_state, batch, sub, tau
-        )
+        )[:4]
         _ = np.asarray(metrics)  # per-iter value barrier (PERF.md §6)
         if pipelined:
             envs.step_wait()
@@ -616,7 +616,7 @@ def measure_env_scale(
                     state["key"], sub = jax.random.split(state["key"])
                     state["params"], state["opt_states"], state["moments_state"], metrics = train_step(
                         state["params"], state["opt_states"], state["moments_state"], batch, sub, jnp.float32(0.02)
-                    )
+                    )[:4]
                     np.asarray(metrics)  # value barrier inside the overlap window
                     grad_steps += 1
                 obs = envs.step_wait()[0]
@@ -661,6 +661,82 @@ def measure_fetch_rtt():
         x = f(x)
         np.asarray(x)
     return round((time.perf_counter() - t0) * 100.0, 1)
+
+
+def measure_learn_health(total_steps: int = 96, timeout_s: float = 240.0):
+    """Informational learn-health block for the always-lands JSON (ISSUE 9).
+
+    Runs a tiny vector-only ppo CLI training run in a SUBPROCESS (forced CPU
+    — cheap, deterministic, and it cannot disturb this process's initialized
+    backend) with the default-on ``diagnostics.health`` layer, then sources
+    the block from THAT run's own crash-safe journal: the final policy loss,
+    the mean in-graph global grad norm, and how many learning-health
+    ``anomaly`` events the detectors journaled.  Not a performance number —
+    it exists so every bench round also records whether the instrumented
+    loop is *learning-shaped* (finite losses, live gradients, no anomalies).
+    """
+    import subprocess
+    import sys
+    import tempfile
+    from pathlib import Path
+
+    from sheeprl_tpu.diagnostics.journal import read_journal
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    overrides = [
+        "exp=ppo",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "metric.log_level=1",
+        "metric.log_every=1",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=1",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.run_test=False",
+        "checkpoint.save_last=False",
+        f"algo.total_steps={int(total_steps)}",
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        subprocess.run(
+            [sys.executable, os.path.join(repo_root, "sheeprl.py"), *overrides],
+            cwd=td,
+            env=env,
+            check=True,
+            timeout=timeout_s,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        journals = sorted(Path(td).rglob("journal.jsonl"))
+        if not journals:
+            raise RuntimeError("learn-health drill run left no journal")
+        events = read_journal(str(journals[-1]))
+    metrics_events = [e for e in events if e.get("event") == "metrics"]
+    final_loss = None
+    grad_norms = []
+    for e in metrics_events:
+        m = e.get("metrics") or {}
+        loss = m.get("Loss/policy_loss")
+        if isinstance(loss, (int, float)):
+            final_loss = float(loss)
+        gnorm = m.get("Telemetry/health/grad_norm", m.get("Grads/global_norm"))
+        if isinstance(gnorm, (int, float)):
+            grad_norms.append(float(gnorm))
+    return {
+        "final_loss": round(final_loss, 6) if final_loss is not None else None,
+        "mean_grad_norm": round(sum(grad_norms) / len(grad_norms), 6) if grad_norms else None,
+        "anomalies": sum(1 for e in events if e.get("event") == "anomaly"),
+        "workload": f"ppo discrete_dummy CPU drill, {int(total_steps)} policy steps",
+    }
 
 
 def _ensure_responsive_device():
@@ -784,6 +860,12 @@ def _run_cpu_fallback(record: dict, precision: str) -> None:
         )
     except Exception as err:  # noqa: BLE001
         record.setdefault("stage_errors", {})["env_scale"] = repr(err)
+    # learn-health block (ISSUE 9): sourced from a tiny CLI drill run's own
+    # journal — informational, lands on the fallback path too
+    try:
+        record["learn_health"] = measure_learn_health()
+    except Exception as err:  # noqa: BLE001
+        record.setdefault("stage_errors", {})["learn_health"] = repr(err)
 
 
 def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
@@ -876,6 +958,13 @@ def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
             "grad_steps_per_sec_e2e_serialized": xl_e2e["grad_steps_per_sec_e2e_serialized"],
         }
 
+    # learn-health block (ISSUE 9): a tiny CPU-subprocess ppo drill whose own
+    # journal supplies final loss / mean grad norm / anomaly count —
+    # informational, cheap, and isolated from the chip backend
+    learn_health = stage("learn_health", 180, measure_learn_health)
+    if learn_health:
+        record["learn_health"] = learn_health
+
 
 def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
@@ -903,6 +992,11 @@ def main() -> None:
         # paths).  Informational — see measure_e2e; the live Telemetry/goodput
         # gauge is the meaningful production number.
         "goodput": None,
+        # learning-dynamics observability (ISSUE 9): final loss / mean grad
+        # norm / anomaly count from a tiny CLI drill run's own journal
+        # (measure_learn_health).  Informational — null when the drill stage
+        # was skipped or failed.
+        "learn_health": None,
     }
     emitted = False
 

@@ -43,11 +43,17 @@ def make_train_step(agent, optimizer, cfg, mesh):
 
     Returns metrics ``[pg_loss, v_loss, grad_norm, nonfinite_steps]``; under
     ``diagnostics.sentinel.policy=skip_update`` a non-finite update is
-    discarded in-graph (params/opt state keep their pre-step values).
+    discarded in-graph (params/opt state keep their pre-step values).  With
+    ``diagnostics.health`` on, a learn-health stats dict (grad/update/param
+    norms, update/weight ratio, dead-unit fraction, value EV) rides the same
+    output fetch; the global grad norm is computed once there and shared
+    with the sentinel's finiteness check.
     """
+    from sheeprl_tpu.diagnostics.health import explained_variance, health_spec, health_stats
     from sheeprl_tpu.diagnostics.sentinel import finite_flag, select_finite, sentinel_spec
 
     sentinel = sentinel_spec(cfg)
+    health = health_spec(cfg)
     world = mesh.devices.size
     distributed = world > 1
     cdt = compute_dtype_of(cfg)
@@ -72,17 +78,31 @@ def make_train_step(agent, optimizer, cfg, mesh):
         if distributed:
             grads = jax.lax.pmean(grads, "data")
             aux = jax.lax.pmean(aux, "data")
-        # one NaN/Inf leaf poisons the global norm: a single scalar health flag
-        gnorm = optax.global_norm(grads)
-        finite = finite_flag(gnorm, *aux)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
+        # one NaN/Inf leaf poisons the global norm: a single scalar health
+        # flag — computed once by health_stats when the health layer is on
+        if health.enabled:
+            hstats = health_stats(
+                grads, updates, params, per_module=health.per_module, dead_eps=health.dead_eps
+            )
+            gnorm = hstats["grad_norm"]
+            # GAE's returns = advantages + values, so the logged rollout
+            # values are recoverable without threading a new batch key
+            ev = explained_variance(data["returns"] - data["advantages"], data["returns"])
+            if distributed:
+                ev = jax.lax.pmean(ev, "data")
+            hstats["value_ev"] = ev
+        else:
+            hstats = {}
+            gnorm = optax.global_norm(grads)
+        finite = finite_flag(gnorm, *aux)
         if sentinel.skip_update:
             params = select_finite(finite, new_params, params)
             opt_state = select_finite(finite, new_opt_state, opt_state)
         else:
             params, opt_state = new_params, new_opt_state
-        return params, opt_state, jnp.stack([*aux, gnorm, 1.0 - finite.astype(jnp.float32)])
+        return params, opt_state, jnp.stack([*aux, gnorm, 1.0 - finite.astype(jnp.float32)]), hstats
 
     if distributed:
         from sheeprl_tpu.parallel.compat import shard_map
@@ -92,7 +112,7 @@ def make_train_step(agent, optimizer, cfg, mesh):
                 update,
                 mesh=mesh,
                 in_specs=(P(), P(), P("data")),
-                out_specs=(P(), P(), P()),
+                out_specs=(P(), P(), P(), P()),
                 check_vma=False,
             )(params, opt_state, data)
 
@@ -292,9 +312,11 @@ def main(runtime, cfg):
         device_data = diag.maybe_inject_nan(iter_num, device_data)
 
         with timer("Time/train_time"), diag.span("train"):
-            params, opt_state, losses = train_step(params, opt_state, device_data)
-            losses = np.asarray(losses)
+            params, opt_state, losses, health = train_step(params, opt_state, device_data)
+            # one blocking d2h for metrics + health stats together
+            losses, health_host = fetch_values(losses, health)
 
+        diag.on_health(policy_step_count, health_host)
         aggregator.update("Loss/policy_loss", float(losses[0]))
         aggregator.update("Loss/value_loss", float(losses[1]))
         aggregator.update("Grads/global_norm", float(losses[2]))

@@ -45,6 +45,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
+from sheeprl_tpu.diagnostics.health import mean_stats
 from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.envs.env import make_env_fns, pipelined_vector_env
 from sheeprl_tpu.envs.player import obs_sharding
@@ -98,9 +99,11 @@ def make_train_step(
     cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
     mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
 
+    from sheeprl_tpu.diagnostics.health import health_spec, health_stats
     from sheeprl_tpu.diagnostics.sentinel import select_finite, sentinel_spec
 
     sentinel = sentinel_spec(cfg)
+    health = health_spec(cfg)
 
     def train_step(params, opt_states, moments_state, batch, key, tau):
         T, B = batch["actions"].shape[:2]
@@ -195,10 +198,10 @@ def make_train_step(
 
         (rec_loss, aux), wm_grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(params["world_model"])
         wm_grads = pmean_tree(wm_grads, axis)
-        updates, opt_states["world_model"] = optimizers["world_model"].update(
+        wm_updates, opt_states["world_model"] = optimizers["world_model"].update(
             wm_grads, opt_states["world_model"], params["world_model"]
         )
-        params["world_model"] = optax.apply_updates(params["world_model"], updates)
+        params["world_model"] = optax.apply_updates(params["world_model"], wm_updates)
 
         # ---------------- BEHAVIOUR LEARNING -------------------------------
         # (uses the freshly updated world model, like the reference)
@@ -284,10 +287,10 @@ def make_train_step(
             params["actor"], moments_state
         )
         actor_grads = pmean_tree(actor_grads, axis)
-        updates, opt_states["actor"] = optimizers["actor"].update(
+        actor_updates, opt_states["actor"] = optimizers["actor"].update(
             actor_grads, opt_states["actor"], params["actor"]
         )
-        params["actor"] = optax.apply_updates(params["actor"], updates)
+        params["actor"] = optax.apply_updates(params["actor"], actor_updates)
         moments_state = aux2["moments"]
 
         # ---------------- CRITIC LEARNING ----------------------------------
@@ -309,10 +312,10 @@ def make_train_step(
 
         value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
         critic_grads = pmean_tree(critic_grads, axis)
-        updates, opt_states["critic"] = optimizers["critic"].update(
+        critic_updates, opt_states["critic"] = optimizers["critic"].update(
             critic_grads, opt_states["critic"], params["critic"]
         )
-        params["critic"] = optax.apply_updates(params["critic"], updates)
+        params["critic"] = optax.apply_updates(params["critic"], critic_updates)
 
         metrics = jnp.stack(
             [
@@ -330,18 +333,32 @@ def make_train_step(
             ]
         )
         metrics = pmean_tree(metrics, axis)
+        # learn-health stats over the three module trees: the grads are
+        # already pmean'd and updates/params are replicated, so the dict is
+        # identical on every device and rides the metric drain's batched
+        # fetch (zero extra syncs; {} when diagnostics.health is off)
+        if health.enabled:
+            hstats = health_stats(
+                {"world_model": wm_grads, "actor": actor_grads, "critic": critic_grads},
+                {"world_model": wm_updates, "actor": actor_updates, "critic": critic_updates},
+                {"world_model": params["world_model"], "actor": params["actor"], "critic": params["critic"]},
+                per_module=health.per_module,
+                dead_eps=health.dead_eps,
+            )
+        else:
+            hstats = {}
         if sentinel.skip_update:
             finite = jnp.all(jnp.isfinite(metrics))
             params, opt_states, moments_state = select_finite(
                 finite, (params, opt_states, moments_state), prev_state
             )
-        return params, opt_states, moments_state, metrics
+        return params, opt_states, moments_state, metrics, hstats
 
     return dp_jit(
         train_step,
         mesh,
         in_specs=(P(), P(), P(), batch_spec(batch_axis=1), P(), P()),
-        out_specs=(P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
         donate_argnums=(0, 1, 2),
     )
 
@@ -720,12 +737,17 @@ def _dreamer_main(
                         else:
                             tau = 0.0
                         rng_key, train_key = jax.random.split(rng_key)
-                        params, opt_states, moments_state, metrics = train_step(
+                        out = train_step(
                             params, opt_states, moments_state, batch, train_key, jnp.float32(tau)
                         )
+                        # P2E's step builders return 4 outputs (no health
+                        # tree); the DV3/JEPA steps return 5 ({} when
+                        # diagnostics.health is off)
+                        params, opt_states, moments_state, metrics = out[:4]
+                        step_health = out[4] if len(out) > 4 else None
                         cumulative_grad_steps += 1
                     train_step_count += 1
-                metrics_drain.append(metrics)
+                metrics_drain.append(metrics, extra=step_health)
 
         # ---- collect the env step results (device keeps training) --------
         with timer("Time/env_interaction_time"), diag.span("env_wait"):
@@ -806,6 +828,9 @@ def _dreamer_main(
                 aggregator,
                 metric_order,
                 observer=lambda rows: diag.observe_rows(policy_step_count, metric_order, rows),
+                extra_observer=lambda extras: diag.on_health(
+                    policy_step_count, mean_stats(extras)
+                ),
             )
             metrics_dict = aggregator.compute()
             timers = timer.compute()

@@ -84,9 +84,11 @@ def make_train_step(
     projector_def = _HEADS["projector_def"]
     predictor_def = _HEADS["predictor_def"]
 
+    from sheeprl_tpu.diagnostics.health import health_spec, health_stats
     from sheeprl_tpu.diagnostics.sentinel import select_finite, sentinel_spec
 
     sentinel = sentinel_spec(cfg)
+    health = health_spec(cfg)
 
     def train_step(params, opt_states, moments_state, batch, key, tau):
         T, B = batch["actions"].shape[:2]
@@ -197,11 +199,11 @@ def make_train_step(
             (params["world_model"], jepa_online)
         )
         grads = pmean_tree(grads, axis)
-        updates, opt_states["world_model"] = optimizers["world_model"].update(
+        wm_updates, opt_states["world_model"] = optimizers["world_model"].update(
             grads, opt_states["world_model"], (params["world_model"], jepa_online)
         )
         (params["world_model"], jepa_online) = optax.apply_updates(
-            (params["world_model"], jepa_online), updates
+            (params["world_model"], jepa_online), wm_updates
         )
         params["jepa"]["projector"] = jepa_online["projector"]
         params["jepa"]["predictor"] = jepa_online["predictor"]
@@ -294,10 +296,10 @@ def make_train_step(
             params["actor"], moments_state
         )
         actor_grads = pmean_tree(actor_grads, axis)
-        updates, opt_states["actor"] = optimizers["actor"].update(
+        actor_updates, opt_states["actor"] = optimizers["actor"].update(
             actor_grads, opt_states["actor"], params["actor"]
         )
-        params["actor"] = optax.apply_updates(params["actor"], updates)
+        params["actor"] = optax.apply_updates(params["actor"], actor_updates)
         moments_state = aux2["moments"]
 
         imagined_trajectories = aux2["imagined_trajectories"]
@@ -318,10 +320,10 @@ def make_train_step(
 
         value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
         critic_grads = pmean_tree(critic_grads, axis)
-        updates, opt_states["critic"] = optimizers["critic"].update(
+        critic_updates, opt_states["critic"] = optimizers["critic"].update(
             critic_grads, opt_states["critic"], params["critic"]
         )
-        params["critic"] = optax.apply_updates(params["critic"], updates)
+        params["critic"] = optax.apply_updates(params["critic"], critic_updates)
 
         metrics = jnp.stack(
             [
@@ -339,18 +341,50 @@ def make_train_step(
             ]
         )
         metrics = pmean_tree(metrics, axis)
+        # learn-health stats: the JEPA heads are their own top-level module
+        # (grads[1] / wm_updates[1] are the online projector+predictor); all
+        # inputs are pmean'd/replicated so the dict rides the metric drain's
+        # batched fetch unchanged across devices
+        if health.enabled:
+            hstats = health_stats(
+                {
+                    "world_model": grads[0],
+                    "jepa": grads[1],
+                    "actor": actor_grads,
+                    "critic": critic_grads,
+                },
+                {
+                    "world_model": wm_updates[0],
+                    "jepa": wm_updates[1],
+                    "actor": actor_updates,
+                    "critic": critic_updates,
+                },
+                {
+                    "world_model": params["world_model"],
+                    "jepa": {
+                        "projector": params["jepa"]["projector"],
+                        "predictor": params["jepa"]["predictor"],
+                    },
+                    "actor": params["actor"],
+                    "critic": params["critic"],
+                },
+                per_module=health.per_module,
+                dead_eps=health.dead_eps,
+            )
+        else:
+            hstats = {}
         if sentinel.skip_update:
             finite = jnp.all(jnp.isfinite(metrics))
             params, opt_states, moments_state = select_finite(
                 finite, (params, opt_states, moments_state), prev_state
             )
-        return params, opt_states, moments_state, metrics
+        return params, opt_states, moments_state, metrics, hstats
 
     return dp_jit(
         train_step,
         mesh,
         in_specs=(P(), P(), P(), batch_spec(batch_axis=1), P(), P()),
-        out_specs=(P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
         donate_argnums=(0, 1, 2),
     )
 

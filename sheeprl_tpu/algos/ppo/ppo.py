@@ -58,10 +58,20 @@ def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, batch_siz
     the existing metric fetch, and under
     ``diagnostics.sentinel.policy=skip_update`` a non-finite minibatch update
     is discarded in-graph (params/opt state keep their pre-step values).
+
+    With ``diagnostics.health`` on (the default) the step also returns a
+    learn-health stats dict (``health_stats``: per-module grad/update/param
+    norms, update/weight ratio, dead-unit fraction, plus the value-function
+    explained variance) that rides the same output fetch — the global grad
+    norm is computed ONCE there and shared with the sentinel's finiteness
+    check.  Disabled, the fourth output is an empty dict and the graph is
+    unchanged.
     """
+    from sheeprl_tpu.diagnostics.health import explained_variance, health_spec, health_stats
     from sheeprl_tpu.diagnostics.sentinel import finite_flag, select_finite, sentinel_spec
 
     sentinel = sentinel_spec(cfg)
+    health = health_spec(cfg)
     world = mesh.devices.size
     distributed = world > 1
     cdt = compute_dtype_of(cfg)  # bf16 under fabric.precision=bf16-*
@@ -112,29 +122,48 @@ def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, batch_siz
                 if distributed:
                     grads = jax.lax.pmean(grads, "data")
                     aux = jax.lax.pmean(aux, "data")
-                # any NaN/Inf gradient leaf poisons the global norm, so one
-                # scalar check covers the whole tree; pmean'd inputs mean
-                # every device takes the same branch of the select below
-                gnorm = optax.global_norm(grads)
-                finite = finite_flag(gnorm, *aux)
                 updates, new_opt_state = optimizer.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
+                # any NaN/Inf gradient leaf poisons the global norm, so one
+                # scalar check covers the whole tree; pmean'd inputs mean
+                # every device takes the same branch of the select below.
+                # With health on, the norm comes from health_stats — one
+                # whole-tree reduction shared by sentinel + health gauges.
+                if health.enabled:
+                    hstats = health_stats(
+                        grads, updates, params, per_module=health.per_module, dead_eps=health.dead_eps
+                    )
+                    gnorm = hstats["grad_norm"]
+                else:
+                    hstats = {}
+                    gnorm = optax.global_norm(grads)
+                finite = finite_flag(gnorm, *aux)
                 if sentinel.skip_update:
                     params = select_finite(finite, new_params, params)
                     opt_state = select_finite(finite, new_opt_state, opt_state)
                 else:
                     params, opt_state = new_params, new_opt_state
                 stats = jnp.stack([*aux, gnorm, 1.0 - finite.astype(jnp.float32)])
-                return (params, opt_state), stats
+                return (params, opt_state), (stats, hstats)
 
             return jax.lax.scan(mb_body, (params, opt_state), idxs)
 
         keys = jax.random.split(key, cfg.algo.update_epochs)
-        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), keys)
+        (params, opt_state), (losses, health_tree) = jax.lax.scan(
+            epoch_body, (params, opt_state), keys
+        )
         flat = losses.reshape(-1, 5)
         # mean losses/grad-norm over minibatches; nonfinite steps are a count
         metrics = jnp.concatenate([jnp.mean(flat[:, :4], axis=0), jnp.sum(flat[:, 4:], axis=0)])
-        return params, opt_state, metrics
+        # health stats average over epochs x minibatches and ride the same
+        # output fetch; value EV is whole-batch (pre-update critic vs returns)
+        health_out = jax.tree_util.tree_map(jnp.mean, health_tree)
+        if health.enabled:
+            ev = explained_variance(data["values"], data["returns"])
+            if distributed:
+                ev = jax.lax.pmean(ev, "data")
+            health_out["value_ev"] = ev
+        return params, opt_state, metrics, health_out
 
     if distributed:
         from sheeprl_tpu.parallel.compat import shard_map
@@ -149,7 +178,7 @@ def make_train_step(agent, optimizer, cfg, mesh, num_minibatches: int, batch_siz
                 body,
                 mesh=mesh,
                 in_specs=(P(), P(), P("data"), P(), P()),
-                out_specs=(P(), P(), P()),
+                out_specs=(P(), P(), P(), P()),
                 check_vma=False,
             )(params, opt_state, data, key, coefs)
 
@@ -442,9 +471,15 @@ def main(runtime, cfg):
                 jnp.asarray(ent_coef, jnp.float32),
                 jnp.asarray(cfg.algo.vf_coef, jnp.float32),
             )
-            params, opt_state, losses = train_step(params, opt_state, device_data, train_key, coefs)
-            losses = np.asarray(losses)
+            params, opt_state, losses, health = train_step(
+                params, opt_state, device_data, train_key, coefs
+            )
+            # ONE blocking d2h for metrics + health stats together: the
+            # health tree rides the fetch the metric vector already paid
+            # for (the CLI e2e pins dispatch and device_get counts)
+            losses, health_host = fetch_values(losses, health)
 
+        diag.on_health(policy_step_count, health_host)
         aggregator.update("Loss/policy_loss", float(losses[0]))
         aggregator.update("Loss/value_loss", float(losses[1]))
         aggregator.update("Loss/entropy_loss", float(losses[2]))

@@ -295,14 +295,16 @@ def main(runtime, cfg):
                 jnp.asarray(ent_coef, jnp.float32),
                 jnp.asarray(cfg.algo.vf_coef, jnp.float32),
             )
-            trainer_params, opt_state, losses = train_step(
+            trainer_params, opt_state, losses, health = train_step(
                 trainer_params, opt_state, device_data, train_key, coefs
             )
-            losses = np.asarray(losses)
+            # one blocking d2h for metrics + health stats together
+            losses, health_host = fetch_values(losses, health)
 
         # ---- params broadcast back to the player (reference :302-305) -----
         player_params = jax.device_put(trainer_params, player_device)
 
+        diag.on_health(policy_step_count, health_host)
         aggregator.update("Loss/policy_loss", float(losses[0]))
         aggregator.update("Loss/value_loss", float(losses[1]))
         aggregator.update("Loss/entropy_loss", float(losses[2]))

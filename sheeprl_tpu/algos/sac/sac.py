@@ -29,7 +29,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
-from sheeprl_tpu.envs.player import obs_sharding
+from sheeprl_tpu.envs.player import fetch_values, obs_sharding
 from sheeprl_tpu.parallel.dp import local_sample_size
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -46,11 +46,18 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
     grad_norm, nonfinite_steps]``; under
     ``diagnostics.sentinel.policy=skip_update`` a scan step whose losses or
     combined grad norm go non-finite has its whole critic/target/actor/alpha
-    update discarded in-graph (the carry keeps its pre-step values).
+    update discarded in-graph (the carry keeps its pre-step values).  With
+    ``diagnostics.health`` on, a learn-health stats dict over the
+    actor/critic/alpha module trio (grad/update/param norms, update/weight
+    ratio, dead-unit fraction — averaged over the scan's gradient steps)
+    rides the same output fetch; the combined grad norm is computed once
+    there and shared with the sentinel's finiteness check.
     """
+    from sheeprl_tpu.diagnostics.health import health_spec, health_stats
     from sheeprl_tpu.diagnostics.sentinel import finite_flag, select_finite, sentinel_spec
 
     sentinel = sentinel_spec(cfg)
+    health = health_spec(cfg)
     world = mesh.devices.size
     distributed = world > 1
     tau = cfg.algo.tau
@@ -92,10 +99,10 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
         if distributed:
             qf_grads = jax.lax.pmean(qf_grads, "data")
             qf_l = jax.lax.pmean(qf_l, "data")
-        updates, opt_states["critic"] = optimizers["critic"].update(
+        critic_updates, opt_states["critic"] = optimizers["critic"].update(
             qf_grads, opt_states["critic"], params["critic"]
         )
-        params["critic"] = optax.apply_updates(params["critic"], updates)
+        params["critic"] = optax.apply_updates(params["critic"], critic_updates)
 
         # --- Polyak target EMA (reference sac.py:55-57, agent.py qfs_target_ema)
         params["target_critic"] = optax.incremental_update(
@@ -118,10 +125,10 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
         if distributed:
             actor_grads = jax.lax.pmean(actor_grads, "data")
             actor_l = jax.lax.pmean(actor_l, "data")
-        updates, opt_states["actor"] = optimizers["actor"].update(
+        actor_updates, opt_states["actor"] = optimizers["actor"].update(
             actor_grads, opt_states["actor"], params["actor"]
         )
-        params["actor"] = optax.apply_updates(params["actor"], updates)
+        params["actor"] = optax.apply_updates(params["actor"], actor_updates)
 
         # --- entropy coefficient update (reference sac.py:68-73) ----------
         def alpha_loss_fn(log_alpha):
@@ -131,31 +138,48 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
         if distributed:
             alpha_grads = jax.lax.pmean(alpha_grads, "data")
             alpha_l = jax.lax.pmean(alpha_l, "data")
-        updates, opt_states["alpha"] = optimizers["alpha"].update(
+        alpha_updates, opt_states["alpha"] = optimizers["alpha"].update(
             alpha_grads, opt_states["alpha"], params["log_alpha"]
         )
-        params["log_alpha"] = optax.apply_updates(params["log_alpha"], updates)
+        params["log_alpha"] = optax.apply_updates(params["log_alpha"], alpha_updates)
 
         # combined grad norm over the three sequential updates; a NaN/Inf in
-        # any grad tree (or loss) poisons it, giving one scalar health flag
-        gnorm = jnp.sqrt(
-            optax.global_norm(qf_grads) ** 2
-            + optax.global_norm(actor_grads) ** 2
-            + optax.global_norm(alpha_grads) ** 2
-        )
+        # any grad tree (or loss) poisons it, giving one scalar health flag.
+        # health_stats over the {actor, critic, alpha} trio computes the
+        # EXACT same combined norm, so the two layers share one reduction.
+        if health.enabled:
+            hstats = health_stats(
+                {"actor": actor_grads, "critic": qf_grads, "alpha": alpha_grads},
+                {"actor": actor_updates, "critic": critic_updates, "alpha": alpha_updates},
+                {"actor": params["actor"], "critic": params["critic"], "alpha": params["log_alpha"]},
+                per_module=health.per_module,
+                dead_eps=health.dead_eps,
+            )
+            gnorm = hstats["grad_norm"]
+        else:
+            hstats = {}
+            gnorm = jnp.sqrt(
+                optax.global_norm(qf_grads) ** 2
+                + optax.global_norm(actor_grads) ** 2
+                + optax.global_norm(alpha_grads) ** 2
+            )
         finite = finite_flag(gnorm, qf_l, actor_l, alpha_l)
         if sentinel.skip_update:
             params = select_finite(finite, params, prev_params)
             opt_states = select_finite(finite, opt_states, prev_opt_states)
 
         stats = jnp.stack([qf_l, actor_l, alpha_l, gnorm, 1.0 - finite.astype(jnp.float32)])
-        return (params, opt_states), stats
+        return (params, opt_states), (stats, hstats)
 
     def update(params, opt_states, data, keys):
-        (params, opt_states), losses = jax.lax.scan(one_step, (params, opt_states), (data, keys))
+        (params, opt_states), (losses, health_tree) = jax.lax.scan(
+            one_step, (params, opt_states), (data, keys)
+        )
         # mean losses/grad-norm over gradient steps; nonfinite steps are a count
         metrics = jnp.concatenate([jnp.mean(losses[:, :4], axis=0), jnp.sum(losses[:, 4:], axis=0)])
-        return params, opt_states, metrics
+        # health stats average over the scan's gradient steps and ride the
+        # same output fetch as the metric vector
+        return params, opt_states, metrics, jax.tree_util.tree_map(jnp.mean, health_tree)
 
     if distributed:
         from sheeprl_tpu.parallel.compat import shard_map
@@ -165,7 +189,7 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
                 update,
                 mesh=mesh,
                 in_specs=(P(), P(), P(None, "data"), P()),
-                out_specs=(P(), P(), P()),
+                out_specs=(P(), P(), P(), P()),
                 check_vma=False,
             )(params, opt_states, data, keys)
 
@@ -301,8 +325,10 @@ def main(runtime, cfg):
             with diag.span("train"):
                 rng_key, scan_key = jax.random.split(rng_key)
                 keys = jax.random.split(scan_key, per_rank_gradient_steps)
-                params, opt_states, losses = train_step(params, opt_states, data, keys)
-                losses = np.asarray(losses)
+                params, opt_states, losses, health = train_step(params, opt_states, data, keys)
+                # one blocking d2h for metrics + health stats together
+                losses, health_host = fetch_values(losses, health)
+        diag.on_health(policy_step_count, health_host)
         aggregator.update("Loss/value_loss", float(losses[0]))
         aggregator.update("Loss/policy_loss", float(losses[1]))
         aggregator.update("Loss/alpha_loss", float(losses[2]))

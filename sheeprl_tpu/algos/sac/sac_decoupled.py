@@ -28,6 +28,7 @@ from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.envs.env import make_env, make_env_fns, pipelined_vector_env
+from sheeprl_tpu.envs.player import fetch_values
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.parallel.precision import cast_floating, compute_dtype_of
@@ -180,10 +181,12 @@ def main(runtime, cfg):
             with diag.span("train", role="trainer"):
                 rng_key, scan_key = jax.random.split(rng_key)
                 keys = jax.random.split(scan_key, per_rank_gradient_steps)
-                params, opt_states, losses = train_step(params, opt_states, data, keys)
-                losses = np.asarray(losses)
+                params, opt_states, losses, health = train_step(params, opt_states, data, keys)
+                # one blocking d2h for metrics + health stats together
+                losses, health_host = fetch_values(losses, health)
         # actor params broadcast back to the player (reference :550-554)
         player_actor_params = jax.device_put(params["actor"], player_device)
+        diag.on_health(policy_step_count, health_host)
         aggregator.update("Loss/value_loss", float(losses[0]))
         aggregator.update("Loss/policy_loss", float(losses[1]))
         aggregator.update("Loss/alpha_loss", float(losses[2]))

@@ -139,6 +139,35 @@ def check_configs(cfg: dotdict) -> None:
                 f"diagnostics.goodput.profile.max_ms must be >= 10 (the capture floor), "
                 f"got {max_ms!r}; set diagnostics.goodput.profile.enabled=False instead"
             )
+    # learning-health knobs: validated here AND in the HealthMonitor ctor
+    # (direct entrypoint callers skip check_configs) so a bad band/window
+    # fails before the run dir exists
+    health_cfg = (cfg.get("diagnostics") or {}).get("health") or {}
+    confirm = health_cfg.get("confirm")
+    if confirm is not None and int(confirm) < 1:
+        raise ValueError(f"diagnostics.health.confirm must be >= 1, got {confirm!r}")
+    health_det_cfg = health_cfg.get("detectors") or {}
+    ratio_low = health_det_cfg.get("update_ratio_low")
+    ratio_high = health_det_cfg.get("update_ratio_high")
+    if ratio_low is not None and ratio_high is not None and float(ratio_low) >= float(ratio_high):
+        raise ValueError(
+            "diagnostics.health.detectors.update_ratio_low must be < update_ratio_high, "
+            f"got {ratio_low!r} >= {ratio_high!r}"
+        )
+    plateau_window = health_det_cfg.get("plateau_window")
+    if plateau_window is not None and int(plateau_window) < 2:
+        raise ValueError(
+            f"diagnostics.health.detectors.plateau_window must be >= 2, got {plateau_window!r}"
+        )
+    if (
+        health_cfg.get("inject_entropy_collapse_iter") is not None
+        and health_det_cfg.get("entropy_floor") is None
+    ):
+        raise ValueError(
+            "diagnostics.health.inject_entropy_collapse_iter requires "
+            "diagnostics.health.detectors.entropy_floor — a drill against a disarmed "
+            "detector could never fire"
+        )
     learning_starts = cfg.algo.get("learning_starts")
     if learning_starts is not None and learning_starts < 0:
         raise ValueError("The `algo.learning_starts` parameter must be greater or equal to zero")

@@ -1,7 +1,8 @@
 """Run-health & observability subsystem.
 
-Six pillars behind one facade (ISSUE 1 tentpole + ISSUE 3 telemetry layer +
-ISSUE 4 memory layer + ISSUE 8 run-lifecycle layer):
+Seven pillars behind one facade (ISSUE 1 tentpole + ISSUE 3 telemetry layer +
+ISSUE 4 memory layer + ISSUE 8 run-lifecycle layer + ISSUE 9 learning-dynamics
+layer):
 
 * :mod:`~sheeprl_tpu.diagnostics.journal` — crash-safe JSONL run journal
   (write-ahead metric/event log; makes TensorBoard archaeology and the
@@ -31,7 +32,15 @@ ISSUE 4 memory layer + ISSUE 8 run-lifecycle layer):
   (all-thread stacks, optional ``jax.profiler`` auto-capture), and the live
   ``Telemetry/run_state`` / ``Telemetry/goodput`` /
   ``Telemetry/time_to_first_step`` gauges (``tools/goodput_report.py``
-  groups a resumed run's ``version_N`` segments post-mortem).
+  groups a resumed run's ``version_N`` segments post-mortem);
+* :mod:`~sheeprl_tpu.diagnostics.health` — learning-dynamics observability
+  (ISSUE 9): jit-compatible per-module grad/update/param statistics riding
+  the guarded train steps' existing output fetch (zero extra device syncs),
+  rolling-window anomaly detectors (entropy collapse, value-EV floor,
+  update/weight-ratio band, loss plateau, dead gradients) journaling
+  flood-controlled ``anomaly``/``anomaly_end`` events, and the live
+  ``Telemetry/health/*`` gauges (``tools/health_report.py`` renders the
+  post-mortem; ``tools/health_diff.py`` gates cross-run regressions).
 
 The facade is constructed once in ``cli.run_algorithm`` from the
 ``configs/diagnostics/`` group and attached to the :class:`Runtime`; training
@@ -50,6 +59,7 @@ from contextlib import contextmanager, nullcontext
 from typing import Any, Dict, Mapping, Optional
 
 from sheeprl_tpu.diagnostics.goodput import GoodputMonitor
+from sheeprl_tpu.diagnostics.health import HealthMonitor, HealthSpec, health_spec, health_stats
 from sheeprl_tpu.diagnostics.journal import (
     JOURNAL_NAME,
     RunJournal,
@@ -73,6 +83,8 @@ __all__ = [
     "Diagnostics",
     "DivergenceDetector",
     "GoodputMonitor",
+    "HealthMonitor",
+    "HealthSpec",
     "JOURNAL_NAME",
     "MEMORY_EVENTS",
     "MemoryMonitor",
@@ -88,6 +100,8 @@ __all__ = [
     "collect_journals",
     "config_hash",
     "find_journal",
+    "health_spec",
+    "health_stats",
     "iter_journal",
     "read_journal",
     "sentinel_spec",
@@ -172,6 +186,11 @@ class Diagnostics:
             goodput = GoodputMonitor(cfg or {})
             if goodput.enabled:
                 self.goodput = goodput
+        self.health: Optional[HealthMonitor] = None
+        if self.enabled:
+            health = HealthMonitor(cfg or {})
+            if health.enabled:
+                self.health = health
         self.journal: Optional[RunJournal] = None
         self.tracer = NullTracer()
         self.metrics_server = None
@@ -239,6 +258,10 @@ class Diagnostics:
             # opened on every rank: the transfer guard must protect every
             # process; journal writes no-op off rank 0 (journal is None there)
             self.memory.open(self._journal_event, self._journal_sync)
+        if self.health is not None and self._rank_zero:
+            # rank-0 only, like the journal: the detectors describe THE run,
+            # and their output is the journal + the Telemetry/health gauges
+            self.health.open(self._journal_event, self._journal_sync)
         if self.goodput is not None and self._rank_zero:
             # rank-0 only, like the journal: the state machine / watchdog
             # describe THE run, and their output is journal + gauges
@@ -321,6 +344,14 @@ class Diagnostics:
             for k, v in good["info"].items():
                 if v is not None:
                     info.setdefault(k, v)
+        if self.health is not None and self.health._opened:
+            health = self.health.snapshot()
+            snap.setdefault("gauges", {}).update(health["gauges"])
+            snap.setdefault("counters", {}).update(health["counters"])
+            info = snap.setdefault("info", {})
+            for k, v in health["info"].items():
+                if v is not None:
+                    info.setdefault(k, v)
         if self.journal is not None and self.journal.last_write_t is not None:
             import time
 
@@ -366,6 +397,8 @@ class Diagnostics:
                 summary = self.telemetry.summary() if self.telemetry is not None else {}
                 if goodput_open:
                     summary.update(self.goodput.summary())
+                if self.health is not None and self.health._opened:
+                    summary.update(self.health.summary())
                 self.journal.write("telemetry_summary", **summary)
             if self.telemetry is not None:
                 self.telemetry.close()
@@ -439,11 +472,24 @@ class Diagnostics:
             extra.update(self.memory.interval_metrics())
         if self.goodput is not None:
             extra.update(self.goodput.interval_metrics())
+        if self.health is not None:
+            extra.update(self.health.interval_metrics())
         if not extra:
             return metrics
         merged = dict(metrics)
         merged.update(extra)
         return merged
+
+    # -- learning-health hooks ---------------------------------------------
+    def on_health(self, step: Optional[int], stats: Mapping[str, Any]) -> None:
+        """Digest one train step's fetched ``health_stats`` dict: updates the
+        live ``Telemetry/health/*`` gauges and runs the stats-fed anomaly
+        detectors (update/weight-ratio band, dead-gradient, value-EV floor).
+        No-op until opened, off rank 0, or with an empty dict (the train
+        steps return ``{}`` when ``diagnostics.health`` is disabled, so call
+        sites stay unconditional)."""
+        if self.health is not None and self._rank_zero and stats:
+            self.health.on_stats(step, stats)
 
     # -- memory hooks ------------------------------------------------------
     def register_footprint(self, name: str, tree_or_bytes: Any) -> None:
@@ -472,6 +518,10 @@ class Diagnostics:
         if self._detector is not None and self._rank_zero:
             for event in self._detector.observe(step, metrics):
                 self._journal_divergence(event)
+        if self.health is not None and self._rank_zero:
+            # entropy-collapse / loss-plateau windows feed on the same
+            # aggregated stream the divergence detector watches
+            self.health.observe_metrics(step, metrics)
 
     def on_checkpoint(self, step: Optional[int], path: str) -> None:
         if self.journal is not None:

@@ -224,6 +224,18 @@ def format_event_line(event: Dict[str, Any]) -> str:
         return f"[{clock}] {kind:<12s} {payload.get('fn')} #{payload.get('count')}: {head}"
     if kind == "divergence":
         return f"[{clock}] {kind:<12s} step {payload.get('step')}: {payload.get('kind')}"
+    if kind == "anomaly":
+        window = payload.get("window") or []
+        head = ", ".join(f"{v:g}" for v in window[-4:] if isinstance(v, (int, float)))
+        return (
+            f"[{clock}] {'!! ANOMALY':<12s} {payload.get('kind')} on {payload.get('subject')} "
+            f"at step {payload.get('step')} (window tail: {head})"
+        )
+    if kind == "anomaly_end":
+        return (
+            f"[{clock}] {kind:<12s} {payload.get('kind')} on {payload.get('subject')} cleared "
+            f"at step {payload.get('step')} (active since step {payload.get('since_step')})"
+        )
     if kind == "memory_breakdown":
         components = payload.get("components") or {}
         total = sum(v for v in components.values() if isinstance(v, (int, float)))
@@ -287,6 +299,7 @@ def status_block(events: List[Dict[str, Any]]) -> str:
     lines.append(f"events  {len(events)} total · {len(metrics_events)} intervals · "
                  f"{n_ckpt} checkpoints · {n_rec} recompiles · {n_div} divergences")
     lines.extend(goodput_status_lines(events, live=run_end is None))
+    lines.extend(health_status_lines(events, live=run_end is None))
     lines.extend(memory_status_lines(events))
     return "\n".join(lines)
 
@@ -332,6 +345,54 @@ def goodput_status_lines(events: List[Dict[str, Any]], live: bool = True) -> Lis
     if live and freshest is not None and freshest[1] == "stalled":
         age = time.time() - freshest[0]
         lines.append(f"!! STALLED — no progress journaled for {max(0.0, age):.0f}s")
+    return lines
+
+
+def health_status_lines(events: List[Dict[str, Any]], live: bool = True) -> List[str]:
+    """The learn-health panel (run_monitor, journal_report --follow status
+    block and tools/health_report.py share it): the latest
+    ``Telemetry/health/*`` gauges, anomaly counters, and — ``live`` mode
+    only — an ``!! ANOMALY`` banner while a detector is active.  ``live=False``
+    (post-mortem, mirroring the goodput panel) states the open anomalies in
+    the counters line instead of shouting about a run that no longer exists.
+    Empty when the run journaled no learning-health telemetry."""
+    from sheeprl_tpu.diagnostics.health import active_anomalies
+
+    metrics_events = [e for e in events if e.get("event") == "metrics"]
+    last = (metrics_events[-1].get("metrics") or {}) if metrics_events else {}
+    has_health = any(e.get("event") in ("anomaly", "anomaly_end") for e in events) or any(
+        k.startswith("Telemetry/health/") for k in last
+    )
+    if not has_health:
+        return []
+    lines: List[str] = []
+    parts: List[str] = []
+    for key, label, fmt in (
+        ("Telemetry/health/grad_norm", "grad-norm", "{:.3g}"),
+        ("Telemetry/health/update_ratio", "upd/w", "{:.2g}"),
+        ("Telemetry/health/dead_frac", "dead", "{:.0%}"),
+        ("Telemetry/health/value_ev", "value-ev", "{:.2f}"),
+    ):
+        value = last.get(key)
+        if isinstance(value, (int, float)):
+            parts.append(f"{label} {fmt.format(value)}")
+    if parts:
+        lines.append("health  " + " · ".join(parts))
+    n_anomalies = sum(1 for e in events if e.get("event") == "anomaly")
+    open_anomalies = active_anomalies(events)
+    if n_anomalies:
+        line = f"anomalies  {n_anomalies} fired"
+        if open_anomalies:
+            line += " · open: " + ", ".join(
+                f"{e.get('kind')}({e.get('subject')})" for e in open_anomalies[:4]
+            )
+        lines.append(line)
+    if live and open_anomalies:
+        newest = open_anomalies[-1]
+        lines.append(
+            f"!! ANOMALY — {newest.get('kind')} on {newest.get('subject')} "
+            f"(since step {newest.get('step')}; window in the journal)"
+        )
     return lines
 
 

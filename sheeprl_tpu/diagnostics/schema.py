@@ -55,6 +55,8 @@ EVENT_KINDS: Dict[str, str] = {
     "stall": "watchdog: no progress for stall_threshold_s — all-thread stacks, last state, idle seconds (fsync'd)",
     "stall_end": "the stalled run made progress again (seconds stalled, restored state)",
     "profile_capture": "auto (on stall) or on-demand (/profile) jax.profiler capture: status ok/busy/failed + directory",
+    "anomaly": "learning-health detector fired after `confirm` consecutive breaches — kind, subject, offending window (fsync'd)",
+    "anomaly_end": "the anomalous learning-health condition cleared (kind, subject, step it started at)",
     "run_end": "completed / halted / aborted — absent after a kill",
 }
 
@@ -95,6 +97,8 @@ METRICS: Dict[str, str] = {
     "sheeprl_stalls_total": "stall-watchdog firings (no progress for stall_threshold_s)",
     "sheeprl_stalled_seconds_total": "cumulative seconds spent in the stalled state",
     "sheeprl_profile_captures_total": "successful jax.profiler captures (auto on stall + /profile)",
+    # learning-health counters (HealthMonitor.snapshot()["counters"])
+    "sheeprl_health_anomalies_total": "anomaly events journaled by the learning-health detectors",
     # interval gauges (Telemetry/... keys, prefix-stripped and sanitized)
     "sheeprl_mfu": "model FLOPs utilization vs the device-kind peak",
     "sheeprl_tflops_per_sec": "achieved TFLOP/s over the last interval",
@@ -113,6 +117,16 @@ METRICS: Dict[str, str] = {
     "sheeprl_run_state": "run-state machine index into goodput.STATES (5 = stalled)",
     "sheeprl_goodput": "cumulative productive share since open: train-span seconds / wall seconds",
     "sheeprl_time_to_first_step": "seconds from diagnostics open to the first completed train dispatch",
+    # learning-health gauges (Telemetry/health/*, prefix-stripped; the
+    # per-module detail keys stay journal/TB-only — /metrics exports exactly
+    # this scalar subset)
+    "sheeprl_health_grad_norm": "latest global gradient L2 norm from the in-graph health stats",
+    "sheeprl_health_update_norm": "latest global parameter-update L2 norm",
+    "sheeprl_health_param_norm": "latest global parameter L2 norm",
+    "sheeprl_health_update_ratio": "latest update-to-weight ratio (update_norm / param_norm)",
+    "sheeprl_health_dead_frac": "latest fraction of units whose gradients are ~zero",
+    "sheeprl_health_value_ev": "latest value-function explained variance (ppo/a2c)",
+    "sheeprl_health_anomalies": "learning-health anomalies currently active",
     # memory gauges (Telemetry/hbm_* etc., prefix-stripped)
     "sheeprl_hbm_bytes_in_use": "per-device HBM bytes in use (max over devices)",
     "sheeprl_hbm_peak_bytes": "per-device HBM peak bytes (max over devices)",

@@ -181,28 +181,45 @@ class DeviceMetricsDrain:
     def __init__(self, threshold: int = 256):
         self._threshold = threshold
         self._pending: list = []
+        self._pending_extra: list = []
         self._rows: list = []
+        self._extra_rows: list = []
 
-    def append(self, metrics) -> None:
+    def append(self, metrics, extra=None) -> None:
+        """Queue one device metric vector (plus, optionally, a small device
+        pytree — the learn-health stats dict — fetched in the SAME transfer
+        as the metric rows, so carrying it costs zero extra syncs)."""
         self._pending.append(metrics)
+        self._pending_extra.append(extra)
         if len(self._pending) >= self._threshold:
             self._drain()
 
     def _drain(self) -> None:
         if self._pending:
+            import jax
             import jax.numpy as jnp
-            import numpy as np
 
-            self._rows.extend(np.asarray(jnp.stack(self._pending)))
+            stacked = jnp.stack(self._pending)
+            extras = [e if e else {} for e in self._pending_extra]
+            # ONE device_get for rows + extras together (an empty extras list
+            # degenerates to the plain row fetch)
+            rows, fetched = jax.device_get((stacked, extras))
+            self._rows.extend(rows)
+            self._extra_rows.extend(e for e in fetched if e)
             self._pending.clear()
+            self._pending_extra.clear()
 
-    def flush_into(self, aggregator: "MetricAggregator", metric_order, observer=None) -> None:
+    def flush_into(
+        self, aggregator: "MetricAggregator", metric_order, observer=None, extra_observer=None
+    ) -> None:
         """Fetch everything pending and feed the named aggregator.
 
         ``observer(rows)``, when given, sees the raw per-gradient-step metric
         rows *before* NaN filtering — the diagnostics sentinel uses this to
         detect non-finite train steps that the aggregator would silently drop
-        at compute time."""
+        at compute time.  ``extra_observer(extras)`` sees the fetched extra
+        pytrees of the interval (the Dreamer loops route their learn-health
+        stats dicts to ``diag.on_health`` through it)."""
         self._drain()
         if observer is not None and self._rows:
             observer(list(self._rows))
@@ -210,3 +227,6 @@ class DeviceMetricsDrain:
             for name, value in zip(metric_order, row):
                 aggregator.update(name, float(value))
         self._rows.clear()
+        if extra_observer is not None and self._extra_rows:
+            extra_observer(list(self._extra_rows))
+        self._extra_rows.clear()

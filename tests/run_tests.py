@@ -21,7 +21,10 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
   memory_report rendering — and `test_goodput.py` — run-state machine,
   stall watchdog exactly-once + recovery paths, /profile capture smoke,
   segment accounting, the injected-stall CLI drill and the
-  SIGKILL-then-resume killed-segment e2e), plus `tests/test_tools/test_lint.py` (the
+  SIGKILL-then-resume killed-segment e2e — and `test_health.py` —
+  in-graph health-stats goldens, every anomaly detector, the
+  entropy-collapse CLI drill, the dispatch/fetch-parity e2e and the
+  health_diff red/green fixture pair), plus `tests/test_tools/test_lint.py` (the
   static-analysis framework itself).  The suite is preceded by the full
   `tools/sheeprl_lint.py` run (all pass families: INS instrumentation/
   donation wiring, JIT traced-body purity, CFG config contracts, JRN

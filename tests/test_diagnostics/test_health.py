@@ -1,0 +1,567 @@
+"""Learning-dynamics observability (ISSUE 9): in-graph health stats, anomaly
+detectors, the ppo CLI drills, and the cross-run regression diff.
+
+Layers under test:
+
+* ``health_stats`` golden values on handcrafted pytrees — per-module
+  grouping through flax's single-key wrapper, dead-unit edge cases
+  (all-dead modules, scalar leaves, integer leaves skipped);
+* every detector of the :class:`HealthMonitor` on deterministic
+  observation sequences (confirm semantics, flood control, ``anomaly`` /
+  ``anomaly_end`` pairing, window forensics);
+* the ppo CLI entropy-collapse injection drill (acceptance: exactly one
+  fsync'd ``anomaly`` with window forensics, then ``anomaly_end``);
+* the ppo CLI dispatch/fetch-parity check (acceptance: the instrumented
+  step's dispatch count and the process's ``jax.device_get`` count are
+  IDENTICAL with the health layer on and off — the stats ride existing
+  fetches);
+* ``tools/health_diff.py`` on a red/green journal fixture pair (acceptance:
+  non-zero exit iff a watched trajectory leaves its tolerance band) and the
+  ``tools/health_report.py`` / ``report.health_status_lines`` renderings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.diagnostics.health import (
+    HealthMonitor,
+    active_anomalies,
+    explained_variance,
+    health_spec,
+    health_stats,
+    mean_stats,
+    metric_series,
+    top_level_modules,
+)
+from sheeprl_tpu.diagnostics.report import health_status_lines
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+PPO_TINY = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=1",
+    "metric.log_every=1",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.run_test=False",
+    "checkpoint.save_last=False",
+]
+
+
+def _find_journals() -> list:
+    return sorted(Path("logs").rglob("journal.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# in-graph stats: golden values on handcrafted pytrees
+
+
+def test_health_stats_golden_with_module_grouping():
+    import jax.numpy as jnp
+
+    grads = {
+        "params": {  # flax-style single-key wrapper must NOT become a module
+            "enc": {"kernel": jnp.array([[1.0, 0.0], [2.0, 0.0]]), "bias": jnp.array([0.5, 0.0])},
+            "head": {"kernel": jnp.array([[0.0]])},
+        }
+    }
+    updates = {
+        "params": {
+            "enc": {"kernel": jnp.array([[0.1, 0.0], [0.2, 0.0]]), "bias": jnp.array([0.05, 0.0])},
+            "head": {"kernel": jnp.array([[0.0]])},
+        }
+    }
+    params = {
+        "params": {
+            "enc": {"kernel": jnp.array([[10.0, 1.0], [1.0, 1.0]]), "bias": jnp.array([1.0, 1.0])},
+            "head": {"kernel": jnp.array([[2.0]])},
+        }
+    }
+    stats = health_stats(grads, updates, params, per_module=True)
+
+    assert sorted(top_level_modules(grads)) == ["enc", "head"]
+    # global norms
+    np.testing.assert_allclose(float(stats["grad_norm"]), np.sqrt(1 + 4 + 0.25), rtol=1e-6)
+    np.testing.assert_allclose(float(stats["update_norm"]), np.sqrt(0.01 + 0.04 + 0.0025), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(stats["param_norm"]), np.sqrt(100 + 1 + 1 + 1 + 1 + 1 + 4), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(stats["update_ratio"]), float(stats["update_norm"]) / float(stats["param_norm"]), rtol=1e-5
+    )
+    # dead units: enc kernel col 1 (max|g|=0), bias elem 1, head's only unit
+    # -> 3 dead of 5 (kernel cols 2 + bias elems 2 + head col 1)
+    np.testing.assert_allclose(float(stats["dead_frac"]), 3 / 5, rtol=1e-6)
+    # per-module detail
+    np.testing.assert_allclose(float(stats["module/enc/dead_frac"]), 2 / 4, rtol=1e-6)
+    np.testing.assert_allclose(float(stats["module/head/dead_frac"]), 1.0, rtol=1e-6)
+    assert float(stats["module/head/grad_norm"]) == 0.0
+    np.testing.assert_allclose(float(stats["module/head/param_norm"]), 2.0, rtol=1e-6)
+    # scalar subset only when per_module=False
+    lean = health_stats(grads, updates, params, per_module=False)
+    assert not any(k.startswith("module/") for k in lean)
+
+
+def test_health_stats_edge_cases_scalars_ints_and_all_dead():
+    import jax.numpy as jnp
+
+    # scalar leaf (SAC's log_alpha) is one unit; integer leaves are skipped
+    grads = {"alpha": jnp.asarray(0.0), "steps": jnp.asarray(7, jnp.int32)}
+    updates = {"alpha": jnp.asarray(0.0), "steps": jnp.asarray(0, jnp.int32)}
+    params = {"alpha": jnp.asarray(1.5), "steps": jnp.asarray(7, jnp.int32)}
+    stats = health_stats(grads, updates, params)
+    assert float(stats["dead_frac"]) == 1.0  # the one float unit is dead
+    assert float(stats["grad_norm"]) == 0.0
+    np.testing.assert_allclose(float(stats["param_norm"]), 1.5, rtol=1e-6)
+    # update_ratio stays finite at zero update
+    assert float(stats["update_ratio"]) == 0.0
+    # dead_eps boundary: a grad exactly AT eps counts dead, above it alive
+    g = {"m": jnp.array([1e-8, 2e-8])}
+    assert float(health_stats(g, g, g, dead_eps=1e-8)["dead_frac"]) == 0.5
+
+
+def test_health_spec_tolerates_partial_configs():
+    assert health_spec({}) == (False, False, 1e-8)
+    spec = health_spec(
+        {"diagnostics": {"enabled": True, "health": {"per_module": True, "dead_eps": 1e-6}}}
+    )
+    assert spec.enabled and spec.per_module and spec.dead_eps == 1e-6
+    # diagnostics off globally wins
+    assert not health_spec({"diagnostics": {"enabled": False, "health": {"enabled": True}}}).enabled
+
+
+def test_explained_variance_golden():
+    np.testing.assert_allclose(
+        float(explained_variance(np.array([1.0, 2.0, 3.0]), np.array([1.0, 2.0, 3.0]))), 1.0
+    )
+    # predicting the mean -> EV 0
+    returns = np.array([1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_allclose(
+        float(explained_variance(np.full(4, returns.mean()), returns)), 0.0, atol=1e-6
+    )
+    # zero return variance -> defined as 0, not NaN
+    assert float(explained_variance(np.array([1.0, 2.0]), np.array([5.0, 5.0]))) == 0.0
+
+
+def test_mean_stats_skips_empty_entries():
+    out = mean_stats([{"a": 1.0, "b": 2.0}, None, {}, {"a": 3.0}])
+    assert out == {"a": 2.0, "b": 2.0}
+    assert mean_stats([]) == {}
+
+
+# ---------------------------------------------------------------------------
+# detectors on deterministic observation sequences
+
+
+def _monitor(**health_overrides):
+    events = []
+    synced = []
+    cfg = {
+        "diagnostics": {
+            "enabled": True,
+            "health": {"enabled": True, "confirm": 2, **health_overrides},
+        }
+    }
+    monitor = HealthMonitor(cfg)
+    monitor.open(
+        lambda _event, **f: events.append({"event": _event, **f}),
+        lambda: synced.append(len(events)),
+    )
+    return monitor, events, synced
+
+
+def _kinds(events):
+    return [(e["event"], e.get("kind")) for e in events]
+
+
+def test_update_ratio_band_confirm_and_flood_control():
+    monitor, events, synced = _monitor(
+        detectors={"update_ratio_low": 1e-6, "update_ratio_high": 0.5}
+    )
+    monitor.on_stats(1, {"update_ratio": 2.0})  # breach 1 of 2: no event yet
+    assert events == []
+    monitor.on_stats(2, {"update_ratio": 2.0})  # breach 2: fires, fsync'd
+    assert _kinds(events) == [("anomaly", "update_ratio_band")]
+    assert events[0]["window"] == [2.0, 2.0] and events[0]["step"] == 2
+    assert synced == [1], "the anomaly record must be fsync'd as written"
+    monitor.on_stats(3, {"update_ratio": 3.0})  # still active: flood controlled
+    assert len(events) == 1
+    monitor.on_stats(4, {"update_ratio": 0.01})  # recovery
+    assert _kinds(events)[-1] == ("anomaly_end", "update_ratio_band")
+    assert events[-1]["since_step"] == 2
+    # a single breach after recovery does NOT re-fire (confirm resets)
+    monitor.on_stats(5, {"update_ratio": 1e-9})
+    assert len(events) == 2
+    # the low bound trips too, as its own fresh confirmation run
+    monitor.on_stats(6, {"update_ratio": 1e-9})
+    assert _kinds(events)[-1] == ("anomaly", "update_ratio_band")
+
+
+def test_dead_gradient_detector_per_module():
+    monitor, events, _ = _monitor(detectors={"dead_frac_max": 0.9})
+    stats = {"dead_frac": 0.1, "module/world_model/dead_frac": 1.0, "module/actor/dead_frac": 0.0}
+    monitor.on_stats(1, stats)
+    monitor.on_stats(2, stats)
+    assert _kinds(events) == [("anomaly", "dead_gradient")]
+    assert events[0]["subject"] == "module/world_model/dead_frac"
+    monitor.on_stats(3, {**stats, "module/world_model/dead_frac": 0.2})
+    assert _kinds(events)[-1] == ("anomaly_end", "dead_gradient")
+
+
+def test_value_ev_floor_detector():
+    monitor, events, _ = _monitor(detectors={"value_ev_floor": 0.1, "update_ratio_low": None, "update_ratio_high": None})
+    for step in (1, 2):
+        monitor.on_stats(step, {"value_ev": -0.5})
+    assert _kinds(events) == [("anomaly", "value_ev_floor")]
+    monitor.on_stats(3, {"value_ev": 0.8})
+    assert _kinds(events)[-1] == ("anomaly_end", "value_ev_floor")
+
+
+def test_entropy_collapse_detector_magnitude_floor():
+    monitor, events, _ = _monitor(detectors={"entropy_floor": 0.05})
+    # negative-entropy convention: magnitude counts, sign does not
+    monitor.observe_metrics(1, {"Loss/entropy_loss": -0.9})
+    monitor.observe_metrics(2, {"Loss/entropy_loss": -0.01})
+    monitor.observe_metrics(3, {"Loss/entropy_loss": 0.02})
+    assert _kinds(events) == [("anomaly", "entropy_collapse")]
+    assert events[0]["subject"] == "Loss/entropy_loss"
+    monitor.observe_metrics(4, {"Loss/entropy_loss": -0.9})
+    assert _kinds(events)[-1] == ("anomaly_end", "entropy_collapse")
+
+
+def test_loss_plateau_detector_window_semantics():
+    monitor, events, _ = _monitor(
+        detectors={"plateau_key": "Loss/policy_loss", "plateau_window": 4, "plateau_rtol": 1e-3}
+    )
+    # moving loss: no plateau even after many intervals
+    for i in range(6):
+        monitor.observe_metrics(i, {"Loss/policy_loss": 1.0 + 0.1 * i})
+    assert events == []
+    # frozen loss: fires once the window is full of ~identical values
+    for i in range(6, 12):
+        monitor.observe_metrics(i, {"Loss/policy_loss": 2.0})
+    plateau = [e for e in events if e.get("kind") == "loss_plateau" and e["event"] == "anomaly"]
+    assert len(plateau) == 1 and len(plateau[0]["window"]) == 4
+    monitor.observe_metrics(12, {"Loss/policy_loss": 5.0})
+    assert _kinds(events)[-1] == ("anomaly_end", "loss_plateau")
+
+
+def test_entropy_collapse_injection_is_bounded_and_recovers():
+    """The fault knob forces the watched metric to 0 for exactly `confirm`
+    intervals: one anomaly, then anomaly_end at the next honest interval —
+    with the run's real entropy healthy throughout."""
+    monitor, events, synced = _monitor(
+        detectors={"entropy_floor": 0.05}, inject_entropy_collapse_iter=3
+    )
+    for i in range(1, 8):
+        monitor.observe_metrics(i, {"Loss/entropy_loss": -0.7})
+    anomalies = [e for e in events if e["event"] == "anomaly"]
+    ends = [e for e in events if e["event"] == "anomaly_end"]
+    faults = [e for e in events if e["event"] == "fault_injection"]
+    assert len(anomalies) == 1 and anomalies[0]["kind"] == "entropy_collapse"
+    assert anomalies[0]["step"] == 4  # injected at intervals 3+4, confirm=2
+    assert len(ends) == 1 and ends[0]["step"] == 5
+    assert len(faults) == 1 and faults[0]["kind"] == "entropy_collapse"
+    assert synced, "the injected anomaly must be fsync'd like a real one"
+
+
+def test_gauges_snapshot_and_summary():
+    monitor, _, _ = _monitor(detectors={"update_ratio_high": 0.5, "update_ratio_low": None})
+    monitor.on_stats(1, {"grad_norm": 2.0, "update_ratio": 1.0, "module/actor/grad_norm": 1.5})
+    monitor.on_stats(2, {"grad_norm": 2.0, "update_ratio": 1.0})
+    gauges = monitor.interval_metrics()
+    assert gauges["Telemetry/health/grad_norm"] == 2.0
+    assert gauges["Telemetry/health/module/actor/grad_norm"] == 1.5  # journal/TB detail
+    assert gauges["Telemetry/health/anomalies"] == 1.0
+    snap = monitor.snapshot()
+    assert snap["counters"]["health_anomalies_total"] == 1
+    assert "Telemetry/health/module/actor/grad_norm" not in snap["gauges"], (
+        "/metrics must export only the registered scalar subset"
+    )
+    assert snap["gauges"]["Telemetry/health/anomalies"] == 1.0
+    assert snap["info"]["health_active_anomalies"] == "update_ratio_band:update_ratio"
+    assert monitor.summary() == {"health_anomalies": 1, "health_anomalies_open": 1}
+
+
+def test_monitor_and_cli_validation():
+    with pytest.raises(ValueError, match="confirm"):
+        HealthMonitor({"diagnostics": {"health": {"confirm": 0}}})
+    with pytest.raises(ValueError, match="update_ratio_low"):
+        HealthMonitor(
+            {"diagnostics": {"health": {"detectors": {"update_ratio_low": 1.0, "update_ratio_high": 0.5}}}}
+        )
+    with pytest.raises(ValueError, match="plateau_window"):
+        HealthMonitor({"diagnostics": {"health": {"detectors": {"plateau_window": 1}}}})
+    # the drill against a disarmed detector must fail loudly, not journal a
+    # fault_injection event that can never fire
+    with pytest.raises(ValueError, match="entropy_floor"):
+        HealthMonitor({"diagnostics": {"health": {"inject_entropy_collapse_iter": 2}}})
+
+
+def test_health_status_lines_banner_modes():
+    events = [
+        {"event": "metrics", "t": 1.0, "step": 4, "metrics": {
+            "Telemetry/health/grad_norm": 0.5,
+            "Telemetry/health/update_ratio": 1e-3,
+            "Telemetry/health/dead_frac": 0.25,
+        }},
+        {"event": "anomaly", "t": 2.0, "kind": "entropy_collapse", "subject": "Loss/entropy_loss", "step": 4},
+    ]
+    live = "\n".join(health_status_lines(events, live=True))
+    assert "health" in live and "grad-norm" in live and "dead 25%" in live
+    assert "!! ANOMALY" in live
+    post = "\n".join(health_status_lines(events, live=False))
+    assert "!! ANOMALY" not in post and "open: entropy_collapse" in post
+    # anomaly closed -> no banner even live
+    closed = events + [
+        {"event": "anomaly_end", "t": 3.0, "kind": "entropy_collapse", "subject": "Loss/entropy_loss", "step": 5}
+    ]
+    assert not any("!! ANOMALY" in l for l in health_status_lines(closed, live=True))
+    assert active_anomalies(closed) == []
+    # a journal without health telemetry renders nothing
+    assert health_status_lines([{"event": "metrics", "metrics": {"Loss/a": 1.0}}]) == []
+
+
+# ---------------------------------------------------------------------------
+# ppo CLI e2e drills (acceptance)
+
+
+def test_ppo_cli_entropy_collapse_drill():
+    """Acceptance: the injection drill produces exactly one fsync'd `anomaly`
+    (with window forensics) followed by `anomaly_end` on recovery, through
+    the real CLI, and the health gauges ride every metric interval."""
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            *PPO_TINY,
+            "algo.total_steps=96",  # 6 iterations, one log interval each
+            "diagnostics.health.confirm=2",
+            "diagnostics.health.detectors.entropy_floor=0.05",
+            "diagnostics.health.inject_entropy_collapse_iter=2",
+        ]
+    )
+    (journal_path,) = _find_journals()
+    events = [json.loads(l) for l in journal_path.read_text().splitlines()]
+    anomalies = [e for e in events if e["event"] == "anomaly"]
+    ends = [e for e in events if e["event"] == "anomaly_end"]
+    assert len(anomalies) == 1, f"expected exactly one anomaly, got {anomalies}"
+    assert anomalies[0]["kind"] == "entropy_collapse"
+    assert anomalies[0]["window"] and all(abs(v) < 0.05 for v in anomalies[0]["window"][-2:])
+    assert len(ends) == 1 and ends[0]["kind"] == "entropy_collapse"
+    assert events.index(anomalies[0]) < events.index(ends[0])
+    assert any(
+        e["event"] == "fault_injection" and e.get("kind") == "entropy_collapse" for e in events
+    )
+    # gauges merged into every metric interval after the first train step
+    metrics_events = [e for e in events if e["event"] == "metrics"]
+    gauged = [e for e in metrics_events if "Telemetry/health/grad_norm" in e["metrics"]]
+    assert gauged, "Telemetry/health/* gauges never reached the metric stream"
+    last = gauged[-1]["metrics"]
+    for key in (
+        "Telemetry/health/update_ratio",
+        "Telemetry/health/dead_frac",
+        "Telemetry/health/value_ev",
+        "Telemetry/health/anomalies",
+    ):
+        assert key in last, f"missing {key}"
+    summary = next(e for e in events if e["event"] == "telemetry_summary")
+    assert summary["health_anomalies"] == 1 and summary["health_anomalies_open"] == 0
+
+
+def test_ppo_cli_dispatch_and_fetch_counts_unchanged(monkeypatch):
+    """Acceptance: enabling the health layer changes NEITHER the instrumented
+    step's dispatch count NOR the number of blocking `jax.device_get` calls —
+    the stats tree rides fetches the loop already pays for."""
+    import jax
+
+    from sheeprl_tpu.cli import run
+
+    real_device_get = jax.device_get
+    counts = {"n": 0}
+
+    def counting_device_get(tree):
+        counts["n"] += 1
+        return real_device_get(tree)
+
+    monkeypatch.setattr(jax, "device_get", counting_device_get)
+
+    overrides = [*PPO_TINY, "algo.total_steps=48"]  # 3 identical iterations
+    results = {}
+    for label, extra in (
+        ("off", ["diagnostics.health.enabled=False"]),
+        ("on", []),
+    ):
+        before = set(_find_journals())
+        counts["n"] = 0
+        run([*overrides, *extra])
+        fetches = counts["n"]
+        (journal_path,) = set(_find_journals()) - before
+        events = [json.loads(l) for l in journal_path.read_text().splitlines()]
+        summary = next(e for e in events if e["event"] == "telemetry_summary")
+        results[label] = {
+            "fetches": fetches,
+            "dispatches": summary["instrumented_calls"],
+            "has_health": any(
+                "Telemetry/health/grad_norm" in (e.get("metrics") or {})
+                for e in events
+                if e["event"] == "metrics"
+            ),
+        }
+    assert results["on"]["has_health"] and not results["off"]["has_health"]
+    assert results["on"]["dispatches"] == results["off"]["dispatches"], (
+        "health stats must not add instrumented dispatches"
+    )
+    assert results["on"]["fetches"] == results["off"]["fetches"], (
+        f"health stats added device_get calls: {results}"
+    )
+    assert results["on"]["fetches"] > 0
+
+
+# ---------------------------------------------------------------------------
+# cross-run regression diff: red/green journal fixture pair
+
+
+def _write_journal(dirname: str, loss_curve, rew_curve) -> Path:
+    run_dir = Path(dirname)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    path = run_dir / "journal.jsonl"
+    with open(path, "w") as fp:
+        fp.write(json.dumps({"t": 0.0, "event": "run_start", "algo": "ppo"}) + "\n")
+        for i, (loss, rew) in enumerate(zip(loss_curve, rew_curve)):
+            fp.write(
+                json.dumps(
+                    {
+                        "t": float(i + 1),
+                        "event": "metrics",
+                        "step": (i + 1) * 16,
+                        "metrics": {"Loss/policy_loss": loss, "Rewards/rew_avg": rew},
+                    }
+                )
+                + "\n"
+            )
+        fp.write(json.dumps({"t": 99.0, "event": "run_end", "status": "completed"}) + "\n")
+    return path
+
+
+def test_health_diff_red_green_fixture_pair():
+    base_loss = [1.0, 0.8, 0.6, 0.5, 0.45, 0.4]
+    base_rew = [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+    _write_journal("diff_base", base_loss, base_rew)
+    # green: slight jitter, well inside the 25% band
+    _write_journal("diff_green", [v * 1.03 for v in base_loss], [v * 0.98 for v in base_rew])
+    # red: the loss stops improving and triples late in the run
+    _write_journal("diff_red", [1.0, 0.9, 1.1, 1.3, 1.5, 1.6], base_rew)
+
+    cmd = [sys.executable, str(REPO_ROOT / "tools" / "health_diff.py")]
+    green = subprocess.run(
+        [*cmd, "diff_base", "diff_green", "--json"], capture_output=True, text=True, timeout=120
+    )
+    assert green.returncode == 0, green.stderr[-2000:]
+    report = json.loads(green.stdout)
+    assert report["regressions"] == [] and not report["failed"]
+    assert "Loss/policy_loss" in report["metrics"]
+
+    red = subprocess.run(
+        [*cmd, "diff_base", "diff_red", "--json"], capture_output=True, text=True, timeout=120
+    )
+    assert red.returncode == 1, f"regression must exit non-zero: {red.stdout}\n{red.stderr[-500:]}"
+    report = json.loads(red.stdout)
+    assert report["regressions"] == ["Loss/policy_loss"], report["regressions"]
+    worst = report["metrics"]["Loss/policy_loss"]["worst"]
+    assert worst["deviation"] > worst["band"]
+
+    # usage errors exit 2
+    missing = subprocess.run(
+        [*cmd, "diff_base", "nowhere"], capture_output=True, text=True, timeout=120
+    )
+    assert missing.returncode == 2
+
+
+def test_health_diff_library_alignment_and_missing_metrics():
+    sys.path.insert(0, str(REPO_ROOT / "tools"))
+    try:
+        from health_diff import diff_journals, resample
+    finally:
+        sys.path.pop(0)
+
+    # different lengths/log cadences align by fractional step position
+    base = [{"event": "metrics", "t": i, "step": i * 10, "metrics": {"Loss/a": 1.0}} for i in range(10)]
+    cand = [{"event": "metrics", "t": i, "step": i * 25, "metrics": {"Loss/a": 1.0}} for i in range(4)]
+    result = diff_journals(base, cand, watch=("Loss/",), points=8)
+    assert result["metrics"]["Loss/a"]["regression"] is False
+    # a metric only the baseline watched is reported, not a regression
+    base2 = base + [{"event": "metrics", "t": 99, "step": 990, "metrics": {"Loss/b": 2.0}}]
+    result = diff_journals(base2, cand, watch=("Loss/",), points=8)
+    assert result["missing_in_candidate"] == ["Loss/b"] and result["regressions"] == []
+    # resample interpolates between log points
+    series = [(0.0, 0.0), (10.0, 10.0)]
+    assert resample(series, 3) == [0.0, 5.0, 10.0]
+    assert metric_series(base, "Loss/a")[0] == (0.0, 1.0)
+
+
+def test_health_report_renders_tables_and_timeline():
+    _write_journal("report_run", [1.0, 0.5], [0.0, 1.0])
+    # append module-detail gauges + an anomaly pair to the fixture journal
+    path = Path("report_run/journal.jsonl")
+    lines = path.read_text().splitlines()
+    lines.insert(
+        2,
+        json.dumps(
+            {
+                "t": 1.5,
+                "event": "metrics",
+                "step": 24,
+                "metrics": {
+                    "Telemetry/health/grad_norm": 0.7,
+                    "Telemetry/health/module/actor/dead_frac": 0.5,
+                },
+            }
+        ),
+    )
+    lines.insert(
+        3,
+        json.dumps(
+            {
+                "t": 1.6,
+                "event": "anomaly",
+                "kind": "dead_gradient",
+                "subject": "module/actor/dead_frac",
+                "step": 24,
+                "window": [0.5, 0.5],
+            }
+        ),
+    )
+    path.write_text("\n".join(lines) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "health_report.py"), "report_run"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "per-module trajectories:" in out.stdout
+    assert "actor/dead_frac" in out.stdout
+    assert "anomaly timeline (1 fired):" in out.stdout
+    assert "never cleared" in out.stdout
+    assert "!! ANOMALY" not in out.stdout, "post-mortem mode must suppress the banner"

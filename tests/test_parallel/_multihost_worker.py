@@ -107,7 +107,7 @@ def main() -> None:
     assert chex_leaf.shape[0] == n_local_rows * nproc  # global batch view
 
     coefs = jnp.asarray([cfg.algo.clip_coef, cfg.algo.ent_coef, cfg.algo.vf_coef], jnp.float32)
-    params, opt_state, metrics = train_step(params, opt_state, data, jax.random.PRNGKey(0), coefs)
+    params, opt_state, metrics = train_step(params, opt_state, data, jax.random.PRNGKey(0), coefs)[:3]
     metrics = np.asarray(jax.device_get(metrics))
     assert np.isfinite(metrics).all(), metrics
 

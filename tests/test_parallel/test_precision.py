@@ -175,7 +175,7 @@ def _losses(precision, steps=3):
         key, sub = jax.random.split(key)
         params, opt_states, moments, metrics = step(
             params, opt_states, moments, batch, sub, jnp.float32(0.02)
-        )
+        )[:4]
         out.append(float(metrics[0]))
     return out, params
 

@@ -78,6 +78,55 @@ def test_ins_negative_clean_loop():
     assert _rules(findings) == {"INS006"}  # only the missing-flagship notes
 
 
+HEALTH_MISSING_ALGO = """\
+import jax
+
+def make_train_step(agent, cfg):
+    def update(params, opt_state, data):
+        return params, opt_state
+    return jax.jit(update, donate_argnums=(0, 1))
+
+def main(runtime, cfg, diag):
+    train_step = diag.instrument("train", make_train_step(None, cfg), kind="train", donate_argnums=(0, 1))
+    policy = diag.instrument("policy", None, kind="rollout")
+"""
+
+HEALTH_WIRED_ALGO = """\
+import jax
+from sheeprl_tpu.diagnostics.health import health_spec, health_stats
+
+def make_train_step(agent, cfg):
+    health = health_spec(cfg)
+    def update(params, opt_state, data):
+        grads = params
+        updates = params
+        hstats = health_stats(grads, updates, params, per_module=health.per_module)
+        return params, opt_state, hstats
+    return jax.jit(update, donate_argnums=(0, 1))
+
+def main(runtime, cfg, diag):
+    train_step = diag.instrument("train", make_train_step(None, cfg), kind="train", donate_argnums=(0, 1))
+    policy = diag.instrument("policy", None, kind="rollout")
+"""
+
+
+def test_ins007_positive_guarded_builder_without_health_stats():
+    """A guarded flagship train-step builder (ppo.py here) that never calls
+    health_stats must trip INS007."""
+    index = RepoIndex.from_sources({"sheeprl_tpu/algos/ppo/ppo.py": HEALTH_MISSING_ALGO})
+    findings = ins_pass.run(index)
+    assert "INS007" in _rules(findings)
+    assert any(f.rule == "INS007" and f.file.endswith("ppo/ppo.py") for f in findings)
+
+
+def test_ins007_negative_health_stats_wired():
+    """The same builder with the health_stats hook wired stays INS007-clean
+    (only the other-flagship-files-missing INS006 notes remain)."""
+    index = RepoIndex.from_sources({"sheeprl_tpu/algos/ppo/ppo.py": HEALTH_WIRED_ALGO})
+    findings = ins_pass.run(index)
+    assert "INS007" not in _rules(findings)
+
+
 # ---------------------------------------------------------------------------
 # JIT — traced-body purity
 

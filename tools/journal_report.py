@@ -16,9 +16,12 @@ Accepts a journal file, a ``version_N`` directory, or any run-dir ancestor
 (the newest journal below wins — for ALL segments of a resumed run, use
 ``tools/goodput_report.py``, which groups the ``version_N`` siblings with
 killed-segment detection and time-to-recover).  ``--follow`` streams every
-journal row — including the live ``Telemetry/*`` gauges and the
-``state_change``/``stall`` run-lifecycle events — as the compact one-line
-format shared with ``tools/run_monitor.py``, until the run ends or Ctrl-C.
+journal row — including the live ``Telemetry/*`` gauges, the
+``state_change``/``stall`` run-lifecycle events and the learning-health
+``anomaly``/``anomaly_end`` events (rendered as an ``!! ANOMALY`` line) — as
+the compact one-line format shared with ``tools/run_monitor.py``, until the
+run ends or Ctrl-C (``tools/health_report.py`` renders the full learn-health
+post-mortem).
 """
 
 from __future__ import annotations

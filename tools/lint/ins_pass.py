@@ -17,7 +17,10 @@ Rules:
 * **INS004 / INS005** — a flagship loop module has no ``kind="train"`` /
   ``kind="rollout"`` instrument call at all;
 * **INS006** — a flagship loop file vanished (moved without updating the
-  lint's map).
+  lint's map);
+* **INS007** — a guarded (sentinel-instrumented) flagship train-step builder
+  does not wire the ``health_stats`` learn-health hook (ISSUE 9): the
+  learning-dynamics layer only sees steps that compute the stats in-graph.
 """
 
 from __future__ import annotations
@@ -42,6 +45,19 @@ FLAGSHIP = {
     "dreamer_v3/dreamer_v3.py": {"rollout": False},
 }
 
+# Guarded (sentinel-instrumented) train-step builders that must also wire the
+# in-graph `health_stats` hook (ISSUE 9).  The decoupled loops import their
+# builders from these modules, so the set is the builder-owning files.
+HEALTH_REQUIRED = frozenset(
+    {
+        "ppo/ppo.py",
+        "a2c/a2c.py",
+        "sac/sac.py",
+        "dreamer_v3/dreamer_v3.py",
+        "dreamer_v3_jepa/dreamer_v3_jepa.py",
+    }
+)
+
 RULES = {
     "INS001": "jit inside a make_train_step builder without donate_argnums",
     "INS002": "train_step assigned without going through diag.instrument",
@@ -49,6 +65,7 @@ RULES = {
     "INS004": "flagship loop has no instrument(kind='train') call",
     "INS005": "flagship loop has no instrument(kind='rollout') call",
     "INS006": "flagship loop file not found",
+    "INS007": "guarded flagship train-step builder does not wire health_stats",
 }
 
 
@@ -80,6 +97,7 @@ class _Scanner(ast.NodeVisitor):
         self.rel_path = rel_path
         self.findings: List[Finding] = []
         self.instrument_kinds: List[str] = []
+        self.health_stats_in_builder = False
         self._fn_stack: List[str] = []
 
     def _in_train_step_builder(self) -> bool:
@@ -106,6 +124,8 @@ class _Scanner(ast.NodeVisitor):
                         "double-buffered in HBM",
                     )
                 )
+        if call_name(node) == "health_stats" and self._in_train_step_builder():
+            self.health_stats_in_builder = True
         kind = _instrument_kind(node)
         if kind is not None:
             self.instrument_kinds.append(kind)
@@ -150,6 +170,18 @@ def scan_trees(trees: Dict[str, ast.Module], file_prefix: str = "") -> List[Find
         scanner = _Scanner(file_prefix + rel)
         scanner.visit(trees[rel])
         findings.extend(scanner.findings)
+        if rel in HEALTH_REQUIRED and not scanner.health_stats_in_builder:
+            findings.append(
+                Finding(
+                    "INS007",
+                    "error",
+                    file_prefix + rel,
+                    1,
+                    "guarded train-step builder does not call health_stats — the "
+                    "learning-dynamics layer (Telemetry/health/*, anomaly detectors) "
+                    "is blind to this loop",
+                )
+            )
         spec = FLAGSHIP.get(rel)
         if spec is not None:
             seen_flagship.add(rel)

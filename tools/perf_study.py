@@ -233,7 +233,7 @@ def measure_xl_levers(
             state["key"], sub = jax.random.split(state["key"])
             state["params"], state["opt_states"], state["moments_state"], metrics = train_step(
                 state["params"], state["opt_states"], state["moments_state"], batch, sub, jnp.float32(0.02)
-            )
+            )[:4]
         np.asarray(metrics)  # value barrier: forces the whole block's chain
         return (time.perf_counter() - t0) / block_steps
 

@@ -13,14 +13,18 @@ Shows run identity and state, the latest metric interval (reward, SPS, env
 throughput — env-steps/s + fetch amortization — TFLOP/s, MFU, phase
 breakdown), the run-state / goodput panel (state machine position, the
 cumulative goodput gauge, stall counters — with a ``!! STALLED`` banner
-while the watchdog has the run marked stalled, in BOTH modes), an
+while the watchdog has the run marked stalled, in BOTH modes), a
+learn-health panel (grad-norm, update/weight ratio, dead-unit fraction,
+value EV — with an ``!! ANOMALY`` banner while a learning-health detector
+is active, in BOTH modes), an
 HBM/transfers panel (bytes in use vs
 peak, replay/RSS footprint, host-transfer + donation-miss + OOM counters)
 and recompile/divergence counters; with ``--follow`` it streams every new
 journal row as a compact line (``tools/journal_report.py --follow`` shares
 this exact formatting; ``tools/memory_report.py`` renders the full footprint
 and sharding tables; ``tools/goodput_report.py`` the segment-aware
-post-mortem view, banner suppressed).
+post-mortem view, banner suppressed; ``tools/health_report.py`` the
+learn-health post-mortem, likewise banner-suppressed).
 
 Usage:
     python tools/run_monitor.py logs/runs/ppo/CartPole-v1/<run>/
@@ -142,6 +146,14 @@ def endpoint_status(url: str) -> str:
         if lag is not None:
             banner += f" (journal lag {lag:.0f}s)"
         lines.append(banner)
+    active_anomalies = metrics.get("sheeprl_health_anomalies")
+    if active_anomalies:
+        info = metrics["_labels"].get("sheeprl_run_info") or []
+        which = (info[0][0].get("health_active_anomalies") if info else None) or ""
+        lines.append(
+            f"!! ANOMALY — {active_anomalies:g} learning-health detector(s) active"
+            + (f": {which}" if which else "")
+        )
     parts = []
     steps = metrics.get("sheeprl_policy_steps_total")
     if steps is not None:
@@ -186,6 +198,18 @@ def endpoint_status(url: str) -> str:
             mem_parts.append(f"{label} {format_bytes(value)}")
     if mem_parts:
         lines.append("memory  " + " · ".join(mem_parts))
+    health_parts = []
+    for key, label, fmt in (
+        ("sheeprl_health_grad_norm", "grad-norm", "{:.3g}"),
+        ("sheeprl_health_update_ratio", "upd/w", "{:.2g}"),
+        ("sheeprl_health_dead_frac", "dead", "{:.0%}"),
+        ("sheeprl_health_value_ev", "value-ev", "{:.2f}"),
+    ):
+        value = metrics.get(key)
+        if value is not None:
+            health_parts.append(f"{label} {fmt.format(value)}")
+    if health_parts:
+        lines.append("health  " + " · ".join(health_parts))
     counters = []
     for key, label in (
         ("sheeprl_recompiles_total", "recompiles"),
@@ -197,6 +221,7 @@ def endpoint_status(url: str) -> str:
         ("sheeprl_host_transfers_total", "host transfers"),
         ("sheeprl_donation_miss_leaves_total", "donation-miss leaves"),
         ("sheeprl_oom_events_total", "ooms"),
+        ("sheeprl_health_anomalies_total", "health anomalies"),
     ):
         value = metrics.get(key)
         if value is not None:
