@@ -739,6 +739,151 @@ def measure_learn_health(total_steps: int = 96, timeout_s: float = 240.0):
     }
 
 
+def measure_serving(
+    loads=(1, 4, 16),
+    duration_s: float = 3.0,
+    buckets=(4, 8, 16),
+    max_delay_ms: float = 2.0,
+):
+    """Serving-tier block (ISSUE 11): requests/sec, p50/p99 latency and mean
+    batch width at several offered-load points, measured through the REAL
+    HTTP tier (``POST /act``) by an in-process client swarm.
+
+    The policy is a tiny randomly-initialized vector ppo agent — serving
+    throughput is a property of the batcher + compiled-step pipeline, not of
+    the weights, so no checkpoint/training is needed and the block lands on
+    the CPU-fallback path too (callers pass the smallest load there).
+    """
+    import json as _json
+    import threading
+    import urllib.request
+
+    import gymnasium as gym
+    import numpy as np
+
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.serving.loader import build_policy
+    from sheeprl_tpu.serving.server import PolicyService
+
+    cfg = compose(
+        [
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+            "algo.dense_units=64",
+            "algo.mlp_layers=2",
+        ]
+    )
+    obs_dim = 10
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-20, 20, (obs_dim,), np.float32)})
+    handle = build_policy(cfg, obs_space, gym.spaces.Discrete(6))
+    service = PolicyService(
+        handle, {"batch_buckets": list(buckets), "max_delay_ms": float(max_delay_ms)}
+    )
+    service.start()
+    service.warmup()
+
+    # a minimal HTTP tier rather than direct service calls: latency numbers
+    # include JSON parse + socket turnaround, like a production client sees
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: ANN001
+            pass
+
+        def do_POST(self):  # noqa: N802
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = _json.loads(self.rfile.read(length) or b"{}")
+                result = service.act(payload["obs"])
+                status, body = 200, _json.dumps(
+                    {"action": np.asarray(result["action"]).tolist()}
+                ).encode()
+            except Exception as err:  # noqa: BLE001 — a failed request must
+                # answer 500, not kill the connection (and with it the swarm
+                # client thread whose load the point claims to measure)
+                status, body = 500, _json.dumps({"error": repr(err)}).encode()
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    httpd.daemon_threads = True
+    http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    http_thread.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}/act"
+
+    def swarm(n_clients: int) -> dict:
+        payload = _json.dumps(
+            {"obs": {"state": np.linspace(-1, 1, obs_dim).tolist()}}
+        ).encode()
+        before = service.batcher.stats()
+        stop_t = time.monotonic() + duration_s
+        # per-WINDOW latency samples, measured client-side: the batcher's own
+        # percentile deque is service-lifetime, so reading it here would let
+        # earlier (lower-load) points dilute this point's tail
+        samples = [[] for _ in range(n_clients)]
+
+        client_errors = [0] * n_clients
+
+        def client(i: int) -> None:
+            while time.monotonic() < stop_t:
+                t_req = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(
+                        urllib.request.Request(url, data=payload), timeout=30
+                    ) as resp:
+                        resp.read()
+                except Exception:  # noqa: BLE001 — keep offering load; the
+                    client_errors[i] += 1  # point reports the error count
+                    continue
+                samples[i].append((time.perf_counter() - t_req) * 1000.0)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        after = service.batcher.stats()
+        d_req = after["requests_total"] - before["requests_total"]
+        d_disp = after["dispatches_total"] - before["dispatches_total"]
+        latencies = sorted(v for chunk in samples for v in chunk)
+
+        def pct(p: float):
+            if not latencies:
+                return None
+            rank = min(len(latencies) - 1, int(round(p / 100.0 * (len(latencies) - 1))))
+            return round(latencies[rank], 3)
+
+        return {
+            "clients": n_clients,
+            "requests_per_sec": round(len(latencies) / wall, 2) if wall > 0 else None,
+            "latency_p50_ms": pct(50.0),
+            "latency_p99_ms": pct(99.0),
+            "batch_width_mean": round(d_req / d_disp, 3) if d_disp else None,
+            "errors": sum(client_errors),
+        }
+
+    try:
+        points = [swarm(int(n)) for n in loads]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        http_thread.join(timeout=5)
+        service.close()
+    return {
+        "buckets": list(buckets),
+        "max_delay_ms": float(max_delay_ms),
+        "compiles": service.compile_count,
+        "points": points,
+    }
+
+
 def _ensure_responsive_device():
     """Probe device enumeration in a SUBPROCESS with a timeout: a hung remote
     accelerator (the axon tunnel drops out for minutes at a time — PERF.md
@@ -866,6 +1011,12 @@ def _run_cpu_fallback(record: dict, precision: str) -> None:
         record["learn_health"] = measure_learn_health()
     except Exception as err:  # noqa: BLE001
         record.setdefault("stage_errors", {})["learn_health"] = repr(err)
+    # serving block (ISSUE 11): the smallest offered load only — one CPU core
+    # serving and swarming at once makes larger loads pure queueing noise
+    try:
+        record["serving"] = measure_serving(loads=(2,), duration_s=1.5, buckets=(2, 4))
+    except Exception as err:  # noqa: BLE001
+        record.setdefault("stage_errors", {})["serving"] = repr(err)
 
 
 def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
@@ -965,6 +1116,14 @@ def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
     if learn_health:
         record["learn_health"] = learn_health
 
+    # serving block (ISSUE 11): the batched inference tier under an
+    # in-process client swarm at three offered-load points — requests/sec,
+    # p50/p99 latency and the batch-width amortization the dynamic batcher
+    # achieves (PERF.md §4 is the capacity model the buckets come from)
+    serving = stage("serving", 120, measure_serving)
+    if serving:
+        record["serving"] = serving
+
 
 def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
@@ -997,6 +1156,11 @@ def main() -> None:
         # (measure_learn_health).  Informational — null when the drill stage
         # was skipped or failed.
         "learn_health": None,
+        # serving tier (ISSUE 11): requests/sec, p50/p99 latency and mean
+        # batch width at several offered loads through the real HTTP /act
+        # path (measure_serving; the CPU fallback runs the smallest load).
+        # Null when the stage was skipped or failed.
+        "serving": None,
     }
     emitted = False
 

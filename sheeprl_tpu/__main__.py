@@ -1,6 +1,12 @@
-"""``python -m sheeprl_tpu`` → train CLI (reference sheeprl/__main__.py)."""
+"""``python -m sheeprl_tpu`` → train CLI (reference sheeprl/__main__.py);
+``python -m sheeprl_tpu serve checkpoint_path=...`` → the policy server."""
 
-from sheeprl_tpu.cli import run
+import sys
+
+from sheeprl_tpu.cli import run, serve
 
 if __name__ == "__main__":
-    run()
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        serve(sys.argv[2:])
+    else:
+        run()

@@ -24,12 +24,17 @@ from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry, 
 from sheeprl_tpu.utils.utils import dotdict, nest_dotted, print_config
 
 
-def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+def resume_from_checkpoint(cfg: dotdict, overrides: Sequence[str] = ()) -> dotdict:
     """Merge the saved run config when resuming (reference cli.py:23-57).
 
     The checkpoint's archived ``config.yaml`` is the base; the user may only
     change a restricted set of keys (the reference warns and keeps the ckpt
-    value for the rest).
+    value for the rest).  ``overrides`` is the raw CLI override list: for the
+    ``env`` / ``diagnostics`` groups only the keys the user *explicitly*
+    passed are applied — replacing those whole blocks with the freshly
+    composed ones would silently revert every archived setting the user did
+    not re-type to its group default (and could change observation shapes
+    under the checkpoint).
     """
     ckpt_path = pathlib.Path(cfg.checkpoint.resume_from)
     old_cfg_path = ckpt_path.parent.parent / "config.yaml"
@@ -55,6 +60,27 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     for key in allowed:
         if key in cfg:
             merged[key] = cfg[key]
+    # `diagnostics` and `env` are also overridable — a resumed run must be
+    # able to e.g. raise a stall threshold, point at a new compilation-cache
+    # dir, or retune env host knobs (num_envs, capture_video, executor) —
+    # but ONLY the dotted keys the user explicitly passed: the env identity
+    # stays pinned by the env.id equality check above, and everything the
+    # user did not mention keeps its archived value
+    from sheeprl_tpu.config import deep_merge, yaml_load
+
+    explicit: Dict[str, Any] = {}
+    for ov in overrides:
+        key, _, value = ov.partition("=")
+        key = key.lstrip("+~")
+        if key.split(".", 1)[0] not in ("env", "diagnostics"):
+            continue
+        if "." in key:
+            explicit[key] = yaml_load(value) if value != "" else None
+        else:
+            # group swap (env=atari): take the whole freshly composed block
+            explicit[key] = cfg.get(key)
+    if explicit:
+        deep_merge(merged, dotdict(nest_dotted(explicit)))
     merged.checkpoint.resume_from = str(ckpt_path)
     merged.root_dir = old_cfg.root_dir
     return merged
@@ -286,7 +312,7 @@ def run(args: Optional[Sequence[str]] = None):
         for var in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS"):
             os.environ.setdefault(var, str(int(n_threads)))
     if cfg.checkpoint.resume_from:
-        cfg = resume_from_checkpoint(cfg)
+        cfg = resume_from_checkpoint(cfg, overrides)
     print_config(cfg)
     check_configs(cfg)
     _apply_global_flags(cfg)
@@ -395,6 +421,47 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
     cfg.env.num_envs = 1
     check_configs_evaluation(cfg)
     eval_algorithm(cfg)
+
+
+def serve(args: Optional[Sequence[str]] = None) -> None:
+    """Inference-tier entrypoint ``sheeprl-serve`` / ``python -m sheeprl_tpu
+    serve`` (howto/serving.md): load a checkpoint with its archived run
+    config, start the batched policy server and the health-gated hot-reload
+    watcher.
+
+    Overrides follow the eval/registration pattern: ``checkpoint_path=...``
+    is required, everything else (``serving.port=8080``,
+    ``serving.reload.enabled=False``, ``fabric.accelerator=cpu``, ...) is a
+    dotted override on top of the archived config.
+    """
+    overrides = list(args if args is not None else sys.argv[1:])
+    flat: Dict[str, Any] = {}
+    for ov in overrides:
+        key, _, value = ov.partition("=")
+        flat[key.lstrip("+")] = yaml.safe_load(value) if value != "" else None
+    ckpt = flat.pop("checkpoint_path", None)
+    if ckpt is None:
+        raise ValueError("You must specify the checkpoint path: checkpoint_path=...")
+    ckpt_path = pathlib.Path(ckpt)
+    cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not cfg_path.is_file():
+        raise FileNotFoundError(f"Archived run config not found at '{cfg_path}'")
+    with open(cfg_path) as fp:
+        cfg = dotdict(yaml.safe_load(fp))
+    from sheeprl_tpu.config import compose_group, deep_merge
+
+    deep_merge(cfg, dotdict(nest_dotted(flat)))
+    # checkpoints archived before the serving group existed (or with a
+    # partial block): the group defaults underpin whatever the archive /
+    # overrides carry, so every knob has a value
+    serving = compose_group("serving", "default")
+    deep_merge(serving, cfg.get("serving") or {})
+    cfg.serving = serving
+    # honors the archived config too; nothing has touched jax before this point
+    _force_cpu_platform_if_selected(cfg)
+    from sheeprl_tpu.serving.server import serve_checkpoint
+
+    serve_checkpoint(cfg, str(ckpt_path))
 
 
 def registration(args: Optional[Sequence[str]] = None) -> None:

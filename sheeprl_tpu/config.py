@@ -376,6 +376,18 @@ def compose(
     return dotdict(cfg)
 
 
+def compose_group(
+    group: str, option: str = "default", extra_dirs: Optional[Sequence[str]] = None
+) -> dotdict:
+    """Compose ONE group option outside a full run config (its own defaults
+    list resolved, interpolations against itself).  The serve CLI uses this to
+    backfill the ``serving`` block for checkpoints archived before the group
+    existed."""
+    dirs = _search_dirs(extra_dirs)
+    sub = _compose_group_file(group, option, dirs)
+    return dotdict(resolve_interpolations(sub))
+
+
 def instantiate(node: Mapping[str, Any] | Any, *args: Any, **kwargs: Any) -> Any:
     """Recursive ``_target_`` instantiation (Hydra's ``hydra.utils.instantiate``).
 

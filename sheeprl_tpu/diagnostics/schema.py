@@ -57,6 +57,9 @@ EVENT_KINDS: Dict[str, str] = {
     "profile_capture": "auto (on stall) or on-demand (/profile) jax.profiler capture: status ok/busy/failed + directory",
     "anomaly": "learning-health detector fired after `confirm` consecutive breaches — kind, subject, offending window (fsync'd)",
     "anomaly_end": "the anomalous learning-health condition cleared (kind, subject, step it started at)",
+    "serve_start": "the policy server came up: algo, served checkpoint/step, bind address, batch buckets, watched dir",
+    "ckpt_promote": "hot-reload promoted a new checkpoint (step, path, params version) — atomic swap, no recompile",
+    "ckpt_reject": "hot-reload refused a checkpoint: health-gate anomalies, shape mismatch, or missing journal",
     "run_end": "completed / halted / aborted — absent after a kill",
 }
 
@@ -135,4 +138,20 @@ METRICS: Dict[str, str] = {
     "sheeprl_replay_host_bytes": "replay buffer bytes resident in host RAM",
     "sheeprl_replay_disk_bytes": "replay buffer bytes memmapped on disk",
     "sheeprl_replay_device_bytes": "replay buffer bytes resident in HBM",
+    # serving tier (sheeprl_tpu/serving/server.py snapshot; the serve
+    # /metrics endpoint reuses render_prometheus, so the same naming rules
+    # apply — tools/run_monitor.py --url keys its serving panel off these)
+    "sheeprl_serve_requests_total": "serving: /act requests accepted into the batcher",
+    "sheeprl_serve_dispatches_total": "serving: batched device dispatches (requests amortize into these)",
+    "sheeprl_serve_request_errors_total": "serving: requests failed (queue full, timeout, dispatch error)",
+    "sheeprl_serve_ckpt_promotions_total": "serving: checkpoints hot-promoted by the watcher",
+    "sheeprl_serve_ckpt_rejections_total": "serving: checkpoints refused (health gate / shape mismatch)",
+    "sheeprl_serve_batch_width_total": "serving: dispatches per padded bucket width (label: width)",
+    "sheeprl_serve_latency_p50_ms": "serving: median request latency (enqueue to response)",
+    "sheeprl_serve_latency_p99_ms": "serving: p99 request latency",
+    "sheeprl_serve_requests_per_sec": "serving: request throughput over the recent completion window",
+    "sheeprl_serve_queue_depth": "serving: requests waiting for a dispatch slot",
+    "sheeprl_serve_batch_width_mean": "serving: mean valid rows per dispatch (amortization factor)",
+    "sheeprl_serve_ckpt_step": "serving: policy step of the currently served checkpoint",
+    "sheeprl_serve_last_promote_rejected": "serving: 1 while the newest checkpoint candidate was rejected",
 }

@@ -1,0 +1,54 @@
+"""Policy-as-a-service: a batched, hot-reloading inference tier for
+checkpointed agents (ROADMAP item 3).
+
+Training produces checkpoints; this package serves them.  The architecture is
+SEED-RL-style centralized batched inference (Espeholt et al., 2020) adapted to
+a single-process XLA server on the repo's own building blocks:
+
+* :mod:`~sheeprl_tpu.serving.loader` — checkpoint discovery + per-algo policy
+  adapters (``ppo`` / ``a2c`` / ``sac``) built on ``utils/checkpoint.py`` and
+  the existing agent builders, plus the health gate that reads the *training*
+  run's journal (``active_anomalies``) before a checkpoint may be promoted;
+* :mod:`~sheeprl_tpu.serving.batcher` — the dynamic request batcher: requests
+  queue for up to ``serving.max_delay_ms``, are padded to the nearest
+  MXU-friendly bucket width (``serving.batch_buckets``, defaults derived from
+  the PERF.md §4 batch-width table) and dispatched as ONE device step; padded
+  rows never leak into responses;
+* :mod:`~sheeprl_tpu.serving.server` — :class:`PolicyService` (AOT-compiled
+  per-bucket policy steps, atomic params hot-swap under the dispatch lock,
+  journaled ``ckpt_promote``/``ckpt_reject``), the stdlib HTTP tier
+  (``POST /act`` + ``/metrics`` + ``/healthz``, same pattern as
+  ``diagnostics/metrics_server.py``) and the checkpoint-directory watcher.
+
+Entrypoints: ``python -m sheeprl_tpu serve checkpoint_path=...`` /
+``tools/serve.py`` / the ``sheeprl-serve`` console script.  See
+``howto/serving.md``.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.serving.batcher import DynamicBatcher, ServeError, pick_bucket
+from sheeprl_tpu.serving.loader import (
+    PolicyHandle,
+    build_policy,
+    checkpoint_health,
+    checkpoint_step,
+    latest_checkpoint,
+    load_policy,
+)
+from sheeprl_tpu.serving.server import PolicyService, ServeApp, serve_checkpoint
+
+__all__ = [
+    "DynamicBatcher",
+    "PolicyHandle",
+    "PolicyService",
+    "ServeApp",
+    "ServeError",
+    "build_policy",
+    "checkpoint_health",
+    "checkpoint_step",
+    "latest_checkpoint",
+    "load_policy",
+    "pick_bucket",
+    "serve_checkpoint",
+]

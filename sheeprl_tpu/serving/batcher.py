@@ -1,0 +1,270 @@
+"""Dynamic request batching: many concurrent ``/act`` requests, one device
+dispatch.
+
+The capacity model is PERF.md §4: a single-row policy apply leaves almost the
+whole MXU idle, and throughput rises with batch rows essentially for free
+until the systolic array saturates.  So requests queue for up to
+``max_delay_ms`` (or until a full bucket is waiting), the group is padded to
+the nearest bucket width — every width the service ever dispatches is one of
+``batch_buckets``, so the AOT executable cache never grows past
+``len(buckets) x modes`` entries and steady-state serving never compiles —
+and ONE dispatch fans its rows back out to the waiting requests.
+
+The batcher owns queueing, grouping, timing and stats; what a dispatch *is*
+(slab assembly, params snapshot, the compiled step) is the ``dispatch_fn``
+the service injects — which is also the seam the hot-reload race test uses to
+make dispatches deterministically slow.
+
+Threading model: HTTP handler threads block in :meth:`DynamicBatcher.submit`;
+one daemon dispatcher thread drains the queue.  A params hot-swap never talks
+to the batcher at all — the service snapshots params once per dispatch, so a
+promotion lands between dispatches, never inside one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: MXU-friendly default widths (PERF.md §4: MFU rises monotonically with
+#: batch rows; 8 is the smallest width worth a dispatch, 128 the systolic
+#: array's row count).  ``configs/serving/default.yaml`` mirrors this.
+DEFAULT_BUCKETS: Tuple[int, ...] = (8, 16, 32, 64, 128)
+
+
+class ServeError(RuntimeError):
+    """Request-level failure with an HTTP status (the server maps it)."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = int(status)
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= ``n`` (callers cap group size at ``max(buckets)``,
+    so there is always one)."""
+    for b in sorted(int(x) for x in buckets):
+        if b >= int(n):
+            return b
+    raise ValueError(f"group of {n} exceeds the largest bucket {max(buckets)}")
+
+
+class _Request:
+    __slots__ = ("row", "greedy", "t_enqueue", "event", "result", "error", "abandoned")
+
+    def __init__(self, row: Dict[str, np.ndarray], greedy: bool, t_enqueue: float):
+        self.row = row
+        self.greedy = bool(greedy)
+        self.t_enqueue = t_enqueue
+        self.event = threading.Event()
+        self.result: Optional[Dict[str, Any]] = None
+        self.error: Optional[ServeError] = None
+        # set when the client's submit() gave up: if still queued the request
+        # is removed outright; if already in flight its stats are skipped so
+        # one stalled dispatch cannot poison the latency percentiles
+        self.abandoned = False
+
+
+class DynamicBatcher:
+    """FIFO queue + one dispatcher thread + request/latency accounting.
+
+    ``dispatch_fn(rows, greedy)`` must return ``(actions, meta)`` where
+    ``actions`` is array-like with one leading row per *valid* request (padded
+    rows already sliced off) and ``meta`` is a dict merged into every
+    response (``ckpt_step``, ``batch_width``, ``params_version``, ...).
+    """
+
+    def __init__(
+        self,
+        dispatch_fn: Callable[[List[Dict[str, np.ndarray]], bool], Tuple[Any, Dict[str, Any]]],
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_delay_ms: float = 5.0,
+        max_queue: int = 4096,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not buckets:
+            raise ValueError("batch_buckets must not be empty")
+        self._dispatch_fn = dispatch_fn
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if self.buckets[0] < 1:
+            raise ValueError(f"batch buckets must be >= 1, got {self.buckets}")
+        self.max_batch = self.buckets[-1]
+        self.max_delay_s = max(0.0, float(max_delay_ms)) / 1000.0
+        self.max_queue = int(max_queue)
+        self._clock = clock
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # stats (all under _cond to keep one lock discipline)
+        self.requests_total = 0
+        self.responses_total = 0
+        self.errors_total = 0
+        self.dispatches_total = 0
+        self.rows_total = 0
+        self.width_hist: Dict[int, int] = {}
+        self._latency_ms: deque = deque(maxlen=4096)
+        self._done_t: deque = deque(maxlen=4096)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "DynamicBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="sheeprl-serve-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for req in pending:
+            req.error = ServeError(503, "server shutting down")
+            req.event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- client side -------------------------------------------------------
+    def submit(self, row: Dict[str, np.ndarray], greedy: bool, timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Enqueue one observation row; block until its batch dispatched.
+
+        Returns ``{"action": np.ndarray, **dispatch_meta, "queued_ms": float}``.
+        Raises :class:`ServeError` on overload (503), shutdown (503) or
+        timeout (504).
+        """
+        req = _Request(row, greedy, self._clock())
+        with self._cond:
+            if self._stop:
+                raise ServeError(503, "server shutting down")
+            if len(self._queue) >= self.max_queue:
+                self.errors_total += 1
+                raise ServeError(503, f"request queue full ({self.max_queue})")
+            self.requests_total += 1
+            self._queue.append(req)
+            self._cond.notify_all()
+        if not req.event.wait(timeout_s):
+            with self._cond:
+                self.errors_total += 1
+                req.abandoned = True
+                try:
+                    # still queued: drop it so it never wastes a batch slot
+                    self._queue.remove(req)
+                except ValueError:
+                    pass  # already popped for dispatch; stats are skipped
+            raise ServeError(504, f"no dispatch within {timeout_s:g}s")
+        if req.error is not None:
+            raise req.error
+        assert req.result is not None
+        return req.result
+
+    # -- dispatcher thread -------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait()
+                if self._stop:
+                    return
+                head = self._queue[0]
+                deadline = head.t_enqueue + self.max_delay_s
+                while not self._stop:
+                    ready = self._group_len()
+                    now = self._clock()
+                    if ready >= self.max_batch or now >= deadline:
+                        break
+                    self._cond.wait(max(0.001, deadline - now))
+                if self._stop:
+                    return
+                group: List[_Request] = []
+                while self._queue and len(group) < self.max_batch and self._queue[0].greedy == head.greedy:
+                    group.append(self._queue.popleft())
+            self._dispatch_group(group)
+
+    def _group_len(self) -> int:
+        """Contiguous head run with one greedy flag (a mixed queue dispatches
+        the head mode first; the rest re-queue naturally)."""
+        if not self._queue:
+            return 0
+        flag = self._queue[0].greedy
+        n = 0
+        for req in self._queue:
+            if req.greedy != flag or n >= self.max_batch:
+                break
+            n += 1
+        return n
+
+    def _dispatch_group(self, group: List[_Request]) -> None:
+        try:
+            actions, meta = self._dispatch_fn([r.row for r in group], group[0].greedy)
+        except Exception as err:  # noqa: BLE001 - every waiter must wake
+            error = err if isinstance(err, ServeError) else ServeError(500, f"dispatch failed: {err!r}")
+            with self._cond:
+                self.errors_total += len(group)
+            for req in group:
+                req.error = error
+                req.event.set()
+            return
+        now = self._clock()
+        width = int(meta.get("batch_width", len(group)))
+        with self._cond:
+            self.dispatches_total += 1
+            self.rows_total += len(group)  # device work actually dispatched
+            self.width_hist[width] = self.width_hist.get(width, 0) + 1
+            for req in group:
+                if req.abandoned:
+                    continue  # its client already took the 504
+                self._latency_ms.append((now - req.t_enqueue) * 1000.0)
+                self._done_t.append(now)
+                self.responses_total += 1
+        for i, req in enumerate(group):
+            req.result = {"action": np.asarray(actions[i]), "queued_ms": round((now - req.t_enqueue) * 1000.0, 3), **meta}
+            req.event.set()
+
+    # -- stats -------------------------------------------------------------
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def stats(self) -> Dict[str, Any]:
+        """One consistent stats snapshot (the service folds it into its
+        ``/metrics`` snapshot and the journal's interval events)."""
+        with self._cond:
+            latencies = sorted(self._latency_ms)
+            done = list(self._done_t)
+            out: Dict[str, Any] = {
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "errors_total": self.errors_total,
+                "dispatches_total": self.dispatches_total,
+                "rows_total": self.rows_total,
+                "queue_depth": len(self._queue),
+                "width_hist": dict(self.width_hist),
+            }
+        if latencies:
+            out["latency_p50_ms"] = round(_percentile(latencies, 50.0), 3)
+            out["latency_p99_ms"] = round(_percentile(latencies, 99.0), 3)
+        # from the snapshot, not the live counters: a dispatch completing
+        # between the lock release and here must not skew the mean
+        if out["dispatches_total"]:
+            out["batch_width_mean"] = round(out["rows_total"] / out["dispatches_total"], 3)
+        if len(done) >= 2:
+            window = done[-1] - done[0]
+            if window > 0:
+                out["requests_per_sec"] = round((len(done) - 1) / window, 3)
+        return out
+
+
+def _percentile(sorted_values: List[float], pct: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_values:
+        return 0.0
+    rank = max(0, min(len(sorted_values) - 1, int(round(pct / 100.0 * (len(sorted_values) - 1)))))
+    return float(sorted_values[rank])

@@ -1,0 +1,323 @@
+"""Checkpoint discovery, per-algo policy adapters and the promotion health
+gate for the serving tier.
+
+A :class:`PolicyHandle` is everything the server needs to turn a checkpoint
+into a servable policy, with the algo-specific parts closed over once at build
+time: how a request's observation row is validated, how a group of rows is
+assembled into one padded device slab, the pure ``(params, obs, key) ->
+actions`` step (greedy or stochastic) the service AOT-compiles per batch
+bucket, and how a *new* checkpoint's params are converted for a hot swap.
+
+Adapters exist for the feed-forward actor families — ``ppo`` / ``a2c`` (the
+shared PPO-style agent) and ``sac`` (the tanh-Gaussian actor).  Recurrent and
+model-based policies (``ppo_recurrent``, the Dreamer family) carry per-client
+state across steps, which a stateless request/response tier cannot batch
+without a session layer — :func:`build_policy` rejects them with a clear
+error instead of serving wrong actions.
+
+The health gate mirrors ``tools/health_diff.py``'s machine check: a candidate
+checkpoint is promotable when the training run's journal (the ``version_N``
+dir the checkpoint lives under) has no open learning-health anomalies
+(:func:`~sheeprl_tpu.diagnostics.health.active_anomalies`).  Standalone
+checkpoints without a journal are governed by
+``serving.reload.allow_unjournaled``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from math import prod
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: algo name -> handle builder; the public surface for registering new
+#: servable families (signature: (cfg, obs_space, action_space, agent_state))
+SERVABLE_BUILDERS: Dict[str, Callable] = {}
+
+_CKPT_RE = re.compile(r"ckpt_(\d+)_\d+\.ckpt$")
+
+
+def checkpoint_step(path: str) -> Optional[int]:
+    """Policy step encoded in a checkpoint filename (``ckpt_{step}_{rank}``),
+    or None for foreign spellings (those sort by mtime instead)."""
+    match = _CKPT_RE.search(os.path.basename(str(path)))
+    return int(match.group(1)) if match else None
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Newest checkpoint in a directory: highest encoded step, falling back
+    to mtime for filenames the step pattern does not match."""
+    try:
+        names = [n for n in os.listdir(str(ckpt_dir)) if n.endswith(".ckpt")]
+    except OSError:
+        return None
+    if not names:
+        return None
+
+    def sort_key(name: str) -> Tuple[int, float]:
+        step = checkpoint_step(name)
+        path = os.path.join(str(ckpt_dir), name)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        return (step if step is not None else -1, mtime)
+
+    return os.path.join(str(ckpt_dir), max(names, key=sort_key))
+
+
+def journal_for_checkpoint(ckpt_path: str) -> Optional[str]:
+    """The training run's journal that governs this checkpoint: checkpoints
+    land in ``<version_N>/checkpoint/``, the journal in ``<version_N>/``."""
+    version_dir = os.path.dirname(os.path.dirname(os.path.abspath(str(ckpt_path))))
+    path = os.path.join(version_dir, "journal.jsonl")
+    return path if os.path.isfile(path) else None
+
+
+def checkpoint_health(
+    ckpt_path: str,
+    health_gate: bool = True,
+    allow_unjournaled: bool = True,
+) -> Tuple[bool, str, List[Dict[str, Any]]]:
+    """Is this checkpoint promotable?  Returns ``(ok, reason, open_anomalies)``.
+
+    The machine check from ISSUE 9's down-payment: read the training run's
+    journal next to the checkpoint and refuse promotion while any
+    learning-health ``anomaly`` event has no matching ``anomaly_end``.
+    """
+    if not health_gate:
+        return True, "health gate disabled", []
+    journal_path = journal_for_checkpoint(ckpt_path)
+    if journal_path is None:
+        if allow_unjournaled:
+            return True, "no training journal (allow_unjournaled)", []
+        return False, "no training journal next to the checkpoint", []
+    from sheeprl_tpu.diagnostics.health import active_anomalies
+    from sheeprl_tpu.diagnostics.journal import read_journal
+
+    open_anomalies = active_anomalies(read_journal(journal_path))
+    if open_anomalies:
+        kinds = sorted({f"{e.get('kind')}:{e.get('subject')}" for e in open_anomalies})
+        return False, f"open learning-health anomalies: {', '.join(kinds)}", open_anomalies
+    return True, "journal clean", []
+
+
+# ---------------------------------------------------------------------------
+# the handle
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PolicyHandle:
+    """One servable policy: the algo-specific closures the service drives.
+
+    ``make_step(greedy)`` returns a PURE function of ``(params, obs, key)``
+    (jit/AOT-compilable; the key is traced-but-unused on the greedy path so
+    both modes share one signature).  ``assemble(rows, width)`` pads a request
+    group to the bucket width — the padded rows are zeros and are sliced off
+    before any response sees them.  ``load_params`` converts a *new*
+    checkpoint's ``state["agent"]`` for an atomic hot swap.
+    """
+
+    algo: str
+    obs_spec: Dict[str, Tuple[Tuple[int, ...], str]]
+    action_shape: Tuple[int, ...]
+    params: Any
+    make_step: Callable[[bool], Callable]
+    assemble: Callable[[List[Dict[str, np.ndarray]], int], Any]
+    validate: Callable[[Any], Dict[str, np.ndarray]]
+    load_params: Callable[[Dict[str, Any]], Any]
+    ckpt_path: str = ""
+    ckpt_step: int = 0
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    def zero_obs(self, width: int) -> Any:
+        """A zeros slab at ``width`` (warmup compiles trace against this)."""
+        return self.assemble([], width)
+
+
+def _row_validator(
+    obs_spec: Dict[str, Tuple[Tuple[int, ...], str]],
+) -> Callable[[Any], Dict[str, np.ndarray]]:
+    def validate(obs: Any) -> Dict[str, np.ndarray]:
+        if not isinstance(obs, dict):
+            raise ValueError(f"obs must be a dict of observation keys, got {type(obs).__name__}")
+        row: Dict[str, np.ndarray] = {}
+        for key, (shape, dtype) in obs_spec.items():
+            if key not in obs:
+                raise ValueError(f"obs is missing key {key!r} (expected {sorted(obs_spec)})")
+            arr = np.asarray(obs[key], dtype=dtype)
+            if int(arr.size) != int(prod(shape) if shape else 1):
+                raise ValueError(
+                    f"obs[{key!r}] has {arr.size} elements, expected shape {tuple(shape)}"
+                )
+            row[key] = arr.reshape(shape)
+        return row
+
+    return validate
+
+
+def _dict_assembler(
+    obs_spec: Dict[str, Tuple[Tuple[int, ...], str]],
+) -> Callable[[List[Dict[str, np.ndarray]], int], Dict[str, np.ndarray]]:
+    def assemble(rows: List[Dict[str, np.ndarray]], width: int) -> Dict[str, np.ndarray]:
+        slab: Dict[str, np.ndarray] = {}
+        for key, (shape, dtype) in obs_spec.items():
+            buf = np.zeros((int(width),) + tuple(shape), dtype=dtype)
+            for i, row in enumerate(rows):
+                buf[i] = row[key]
+            slab[key] = buf
+        return slab
+
+    return assemble
+
+
+def _actions_dim(action_space) -> Tuple[Tuple[int, ...], bool, bool]:
+    import gymnasium as gym
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    return tuple(int(a) for a in actions_dim), is_continuous, is_multidiscrete
+
+
+def _jnp_tree(state: Any) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.asarray, state)
+
+
+def _ppo_like_handle(cfg, obs_space, action_space, agent_state) -> PolicyHandle:
+    """ppo / a2c: the shared feed-forward PPO-style agent — one apply returns
+    ``(actions, log_prob, entropy, value)``; serving keeps the actions."""
+    import importlib
+
+    agent_module = importlib.import_module(f"sheeprl_tpu.algos.{cfg.algo.name}.agent")
+    actions_dim, is_continuous, _ = _actions_dim(action_space)
+    agent, params, _ = agent_module.build_agent(
+        None, actions_dim, is_continuous, cfg, obs_space, agent_state
+    )
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    obs_spec: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+    for k in cnn_keys:
+        obs_spec[k] = (tuple(obs_space[k].shape), "float32")
+    for k in mlp_keys:
+        obs_spec[k] = ((int(prod(obs_space[k].shape)),), "float32")
+
+    def make_step(greedy: bool) -> Callable:
+        def step(p, obs, key):
+            actions, _, _, _ = agent.apply(p, obs, key=key, greedy=greedy)
+            return actions
+
+        return step
+
+    action_shape = (sum(actions_dim),) if is_continuous else (len(actions_dim),)
+    return PolicyHandle(
+        algo=str(cfg.algo.name),
+        obs_spec=obs_spec,
+        action_shape=action_shape,
+        params=params,
+        make_step=make_step,
+        assemble=_dict_assembler(obs_spec),
+        validate=_row_validator(obs_spec),
+        load_params=_jnp_tree,
+        meta={"is_continuous": is_continuous, "actions_dim": list(actions_dim)},
+    )
+
+
+def _sac_handle(cfg, obs_space, action_space, agent_state) -> PolicyHandle:
+    """sac: the tanh-Gaussian actor — greedy is the squashed mean, stochastic
+    is ``sample_and_log_prob``.  Vector keys concatenate into the flat obs the
+    nets consume (same layout as ``algos/sac/utils.py::prepare_obs``)."""
+    from sheeprl_tpu.algos.sac.agent import build_agent
+
+    actor_def, _, params, *_rest = build_agent(None, cfg, obs_space, action_space, agent_state)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_spec = {k: ((int(prod(obs_space[k].shape)),), "float32") for k in mlp_keys}
+
+    def assemble(rows: List[Dict[str, np.ndarray]], width: int) -> np.ndarray:
+        dim = sum(shape[0] for shape, _ in obs_spec.values())
+        buf = np.zeros((int(width), dim), dtype=np.float32)
+        for i, row in enumerate(rows):
+            buf[i] = np.concatenate([row[k] for k in mlp_keys], axis=-1)
+        return buf
+
+    def make_step(greedy: bool) -> Callable:
+        if greedy:
+
+            def step(p, obs, key):
+                return actor_def.apply(p["actor"], obs, method="greedy_action")
+
+        else:
+
+            def step(p, obs, key):
+                action, _ = actor_def.apply(p["actor"], obs, key, method="sample_and_log_prob")
+                return action
+
+        return step
+
+    return PolicyHandle(
+        algo="sac",
+        obs_spec=obs_spec,
+        action_shape=tuple(action_space.shape),
+        params=params,
+        make_step=make_step,
+        assemble=assemble,
+        validate=_row_validator(obs_spec),
+        load_params=_jnp_tree,
+        meta={"is_continuous": True},
+    )
+
+
+SERVABLE_BUILDERS.update({"ppo": _ppo_like_handle, "a2c": _ppo_like_handle, "sac": _sac_handle})
+
+
+def build_policy(cfg, obs_space, action_space, agent_state: Optional[Dict[str, Any]] = None) -> PolicyHandle:
+    """Adapter dispatch: ``cfg.algo.name`` -> :class:`PolicyHandle` (random
+    init params when ``agent_state`` is None — bench.py serves a throughput
+    probe without any checkpoint)."""
+    algo = str(cfg.algo.name)
+    builder = SERVABLE_BUILDERS.get(algo)
+    if builder is None:
+        raise ValueError(
+            f"Algorithm {algo!r} is not servable: the stateless batching tier supports "
+            f"{sorted(SERVABLE_BUILDERS)} (recurrent/model-based policies carry per-client "
+            "state a request/response API cannot batch)"
+        )
+    return builder(cfg, obs_space, action_space, agent_state)
+
+
+def load_policy(cfg, ckpt_path: str) -> PolicyHandle:
+    """Checkpoint -> :class:`PolicyHandle`: read the state, rebuild the obs /
+    action spaces the way the evaluation entrypoints do (one throwaway env —
+    the spaces are not archived anywhere else), then adapter-dispatch."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.envs.env import make_env
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    state = load_state(str(ckpt_path))
+    if "agent" not in state:
+        raise ValueError(f"Checkpoint '{ckpt_path}' has no 'agent' state to serve")
+    cfg.env.capture_video = False
+    env = make_env(cfg, cfg.seed, 0, None, "serve")()
+    try:
+        obs_space = env.observation_space
+        action_space = env.action_space
+    finally:
+        env.close()
+    if not isinstance(obs_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation space (need a Dict): {obs_space}")
+    handle = build_policy(cfg, obs_space, action_space, state["agent"])
+    handle.ckpt_path = str(ckpt_path)
+    handle.ckpt_step = checkpoint_step(ckpt_path) or 0
+    return handle

@@ -1,0 +1,597 @@
+"""The policy server: AOT-compiled batched inference + health-gated
+checkpoint hot-reload behind a stdlib HTTP tier.
+
+Three cooperating pieces, one process:
+
+* :class:`PolicyService` — owns the params (hot-swappable under a lock), the
+  per-``(bucket, mode)`` AOT executable cache, and the dispatch the batcher
+  drives: assemble the padded slab, snapshot params ONCE, run one compiled
+  device step, slice the valid rows.  ``promote`` swaps params atomically
+  between dispatches — same shapes hit the existing executables, so a
+  promotion never recompiles (a shape-changing checkpoint is rejected
+  instead of poisoning the cache);
+* :class:`ServeApp` — ``ThreadingHTTPServer`` (the
+  ``diagnostics/metrics_server.py`` pattern: handler threads only touch
+  lock-protected state) serving ``POST /act``, ``GET /metrics`` (Prometheus
+  text, ``sheeprl_serve_*`` family) and ``GET /healthz``, plus the
+  checkpoint-directory watcher thread;
+* the watcher — polls the training run's checkpoint dir, gates every new
+  checkpoint on the run's health journal
+  (:func:`~sheeprl_tpu.serving.loader.checkpoint_health`) and journals the
+  decision as ``ckpt_promote`` / ``ckpt_reject`` in the serving run's own
+  reused :class:`~sheeprl_tpu.diagnostics.journal.RunJournal`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.serving.batcher import DEFAULT_BUCKETS, DynamicBatcher, ServeError, pick_bucket
+from sheeprl_tpu.serving.loader import (
+    PolicyHandle,
+    checkpoint_health,
+    checkpoint_step,
+    latest_checkpoint,
+    load_policy,
+)
+
+SERVE_GAUGE_PREFIX = "Telemetry/serve/"
+
+
+class PolicyService:
+    """Batched inference over one hot-swappable params tree.
+
+    ``aot=True`` (the default) pre-lowers and compiles one executable per
+    ``(bucket width, greedy)`` signature via the same ``lower().compile()``
+    path the telemetry layer uses, donating the obs slab's device buffer on
+    backends that support donation; ``aot=False`` calls the pure step
+    directly (the test seam for host-side fake policies).
+    """
+
+    def __init__(
+        self,
+        handle: PolicyHandle,
+        serving_cfg: Optional[Mapping[str, Any]] = None,
+        journal: Any = None,
+        aot: bool = True,
+    ):
+        cfg = dict(serving_cfg or {})
+        self.handle = handle
+        self._journal = journal
+        self._aot = bool(aot)
+        self.default_greedy = bool(cfg.get("greedy", True))
+        buckets = cfg.get("batch_buckets") or list(DEFAULT_BUCKETS)
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.batcher = DynamicBatcher(
+            self._dispatch,
+            buckets=self.buckets,
+            max_delay_ms=float(cfg.get("max_delay_ms", 5.0)),
+            max_queue=int(cfg.get("max_queue", 4096)),
+        )
+        self._params_lock = threading.Lock()
+        self._params = handle.params
+        self._params_version = 0
+        self.ckpt_step = int(handle.ckpt_step)
+        self.ckpt_path = str(handle.ckpt_path)
+        self._compile_lock = threading.Lock()
+        self._compiled: Dict[Tuple[int, bool], Callable] = {}
+        self.compile_count = 0
+        self.promotions_total = 0
+        self.rejections_total = 0
+        self.last_promote_rejected = False
+        self._dispatch_counter = 0
+        self._base_key = None
+        # test seam: a per-dispatch sleep AFTER the params snapshot, so the
+        # hot-reload race test can deterministically overlap a promotion with
+        # an in-flight batch
+        self._step_delay_s: Optional[float] = None
+        self.info: Dict[str, Any] = {
+            "algo": handle.algo,
+            "role": "serve",
+            "ckpt_path": self.ckpt_path or None,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "PolicyService":
+        self.batcher.start()
+        return self
+
+    def warmup(self) -> None:
+        """Compile every (bucket, mode) executable up front so no request —
+        including the first ``{"greedy": false}`` one — ever pays an XLA
+        compile on the dispatcher thread (which would stall every queued
+        request behind it)."""
+        for bucket in self.buckets:
+            for greedy in (True, False):
+                self._compiled_step(bucket, greedy)
+
+    def close(self) -> None:
+        self.batcher.close()
+
+    # -- the compiled step -------------------------------------------------
+    def _compiled_step(self, width: int, greedy: bool) -> Callable:
+        key = (int(width), bool(greedy))
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        with self._compile_lock:
+            fn = self._compiled.get(key)
+            if fn is not None:
+                return fn
+            pure = self.handle.make_step(bool(greedy))
+            if not self._aot:
+                compiled = pure
+            else:
+                import jax
+
+                # the obs slab is consumed by the step — donate its buffer
+                # where the backend supports donation (CPU does not; donating
+                # there only emits warnings)
+                donate = () if jax.default_backend() == "cpu" else (1,)
+                jitted = jax.jit(pure, donate_argnums=donate)
+                obs0 = self.handle.zero_obs(int(width))
+                key0 = jax.random.PRNGKey(0)
+                with self._params_lock:
+                    params = self._params
+                compiled = jitted.lower(params, obs0, key0).compile()
+                self.compile_count += 1
+            self._compiled[key] = compiled
+            return compiled
+
+    def _next_key(self):
+        import jax
+
+        if self._base_key is None:
+            self._base_key = jax.random.PRNGKey(int(time.time_ns() % (2**31)))
+        return jax.random.fold_in(self._base_key, self._dispatch_counter)
+
+    # -- dispatch (called from the batcher thread) -------------------------
+    def _dispatch(self, rows: List[Dict[str, np.ndarray]], greedy: bool) -> Tuple[Any, Dict[str, Any]]:
+        width = pick_bucket(len(rows), self.buckets)
+        obs = self.handle.assemble(rows, width)
+        # ONE params snapshot per dispatch: a concurrent promote() swaps the
+        # reference for the NEXT dispatch; this batch is internally consistent
+        with self._params_lock:
+            params = self._params
+            version = self._params_version
+            step = self.ckpt_step
+        if self._step_delay_s:
+            time.sleep(self._step_delay_s)
+        self._dispatch_counter += 1
+        fn = self._compiled_step(width, greedy)
+        if self._aot:
+            import jax
+
+            key = self._next_key() if not greedy else jax.random.PRNGKey(0)
+        else:
+            key = None
+        out = np.asarray(fn(params, obs, key))
+        meta = {
+            "ckpt_step": step,
+            "params_version": version,
+            "batch_width": width,
+            "batch_rows": len(rows),
+            "dispatch_id": self._dispatch_counter,
+        }
+        return out[: len(rows)], meta
+
+    # -- request entry (called from HTTP handler threads) ------------------
+    def act(self, obs: Any, greedy: Optional[bool] = None, timeout_s: float = 30.0) -> Dict[str, Any]:
+        row = self.handle.validate(obs)
+        use_greedy = self.default_greedy if greedy is None else bool(greedy)
+        return self.batcher.submit(row, use_greedy, timeout_s=timeout_s)
+
+    # -- hot reload --------------------------------------------------------
+    def promote(self, params: Any, step: int, path: str, source: str = "watch") -> bool:
+        """Atomically swap the served params.  Same-shaped trees keep every
+        compiled executable (AOT cache hit — params are call arguments, not
+        trace constants); a different tree is rejected, never half-installed.
+        """
+        mismatch = self._shape_mismatch(params)
+        if mismatch:
+            self.reject(path, f"param tree mismatch: {mismatch}")
+            return False
+        with self._params_lock:
+            self._params = params
+            self._params_version += 1
+            self.ckpt_step = int(step)
+            self.ckpt_path = str(path)
+        self.promotions_total += 1
+        self.last_promote_rejected = False
+        self.info["ckpt_path"] = str(path)
+        if self._journal is not None:
+            self._journal.write(
+                "ckpt_promote", step=int(step), path=str(path), source=source,
+                params_version=self._params_version,
+            )
+        return True
+
+    def reject(self, path: str, reason: str, anomalies: Optional[List[Dict[str, Any]]] = None) -> None:
+        self.rejections_total += 1
+        self.last_promote_rejected = True
+        if self._journal is not None:
+            self._journal.write(
+                "ckpt_reject",
+                step=checkpoint_step(path),
+                path=str(path),
+                reason=str(reason),
+                anomalies=[
+                    {"kind": e.get("kind"), "subject": e.get("subject"), "step": e.get("step")}
+                    for e in (anomalies or [])
+                ],
+            )
+
+    def _shape_mismatch(self, params: Any) -> Optional[str]:
+        import jax
+
+        with self._params_lock:
+            current = self._params
+        old_leaves, old_def = jax.tree_util.tree_flatten(current)
+        new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        if old_def != new_def:
+            return "pytree structure changed"
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            o_shape, n_shape = getattr(o, "shape", None), getattr(n, "shape", None)
+            if o_shape != n_shape:
+                return f"leaf[{i}] shape {o_shape} -> {n_shape}"
+            # dtype matters as much as shape: the AOT executables are
+            # specialized to the old avals, and a bf16-retrained tree would
+            # fail every dispatch AFTER the old params were discarded
+            o_dtype, n_dtype = getattr(o, "dtype", None), getattr(n, "dtype", None)
+            if o_dtype != n_dtype:
+                return f"leaf[{i}] dtype {o_dtype} -> {n_dtype}"
+        return None
+
+    # -- observability -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Metrics-server-shaped snapshot: ``render_prometheus`` exports the
+        gauges/counters as the ``sheeprl_serve_*`` family (schema-registered
+        in ``diagnostics/schema.py``)."""
+        stats = self.batcher.stats()
+        gauges: Dict[str, Any] = {
+            SERVE_GAUGE_PREFIX + "queue_depth": stats["queue_depth"],
+            SERVE_GAUGE_PREFIX + "ckpt_step": self.ckpt_step,
+            SERVE_GAUGE_PREFIX + "last_promote_rejected": int(self.last_promote_rejected),
+        }
+        for src, name in (
+            ("latency_p50_ms", "latency_p50_ms"),
+            ("latency_p99_ms", "latency_p99_ms"),
+            ("requests_per_sec", "requests_per_sec"),
+            ("batch_width_mean", "batch_width_mean"),
+        ):
+            if src in stats:
+                gauges[SERVE_GAUGE_PREFIX + name] = stats[src]
+        return {
+            "info": {k: v for k, v in self.info.items() if v is not None},
+            "gauges": gauges,
+            "counters": {
+                "serve_requests_total": stats["requests_total"],
+                "serve_dispatches_total": stats["dispatches_total"],
+                "serve_request_errors_total": stats["errors_total"],
+                "serve_ckpt_promotions_total": self.promotions_total,
+                "serve_ckpt_rejections_total": self.rejections_total,
+            },
+            "batch_width_hist": stats["width_hist"],
+        }
+
+
+def render_serving_metrics(snapshot: Mapping[str, Any]) -> str:
+    """Prometheus text for a service snapshot: the shared renderer plus the
+    batch-width histogram as a labeled counter family."""
+    from sheeprl_tpu.diagnostics.metrics_server import render_prometheus
+
+    body = render_prometheus(snapshot)
+    hist = snapshot.get("batch_width_hist") or {}
+    if hist:
+        lines = ["# TYPE sheeprl_serve_batch_width_total counter"]
+        for width, count in sorted(hist.items()):
+            lines.append(f'sheeprl_serve_batch_width_total{{width="{int(width)}"}} {int(count)}')
+        body += "\n".join(lines) + "\n"
+    return body
+
+
+class CheckpointWatcher(threading.Thread):
+    """Poll the checkpoint dir; promote new healthy checkpoints, journal the
+    verdict either way.  Also the serving journal's metrics heartbeat."""
+
+    def __init__(
+        self,
+        service: PolicyService,
+        watch_dir: str,
+        poll_s: float = 2.0,
+        health_gate: bool = True,
+        allow_unjournaled: bool = True,
+        journal: Any = None,
+        journal_every_s: float = 10.0,
+    ):
+        super().__init__(name="sheeprl-serve-watcher", daemon=True)
+        self.service = service
+        self.watch_dir = str(watch_dir)
+        self.poll_s = max(0.05, float(poll_s))
+        self.health_gate = bool(health_gate)
+        self.allow_unjournaled = bool(allow_unjournaled)
+        self._journal = journal
+        self.journal_every_s = max(0.0, float(journal_every_s))
+        # health rejections are RETRYABLE — the gate re-evaluates every poll
+        # (an anomaly that later journals anomaly_end unblocks the ckpt) but
+        # journals ckpt_reject only once per path; shape mismatches are
+        # permanent for a path (re-loading the file every poll buys nothing)
+        self._rejected_logged: set = set()
+        self._rejected_permanent: set = set()
+        # newness fallback for foreign filenames (registry artifacts without
+        # a ckpt_{step}_{rank} name): promotable iff newer than whatever was
+        # installed last — seeded from the initially served checkpoint
+        try:
+            self._promoted_mtime: Optional[float] = os.path.getmtime(service.ckpt_path)
+        except OSError:
+            self._promoted_mtime = None
+        # NOT named _stop: threading.Thread.join() calls an internal
+        # self._stop() on 3.10 and an Event there shadows it
+        self._stop_event = threading.Event()
+        self._last_journal_t = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        self.join(timeout=5)
+
+    def run(self) -> None:
+        while not self._stop_event.wait(self.poll_s):
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001 - the watcher must outlive bad files
+                pass
+            if self._journal is not None and self.journal_every_s:
+                now = time.monotonic()
+                if now - self._last_journal_t >= self.journal_every_s:
+                    self._last_journal_t = now
+                    snap = self.service.snapshot()
+                    stats = self.service.batcher.stats()
+                    self._journal.write(
+                        "metrics", step=stats["requests_total"], metrics=snap["gauges"]
+                    )
+
+    def check_once(self) -> Optional[bool]:
+        """One poll: returns True on promote, False on a newly journaled
+        reject, None on no-op (exposed for deterministic tests)."""
+        candidate = latest_checkpoint(self.watch_dir)
+        if candidate is None or candidate in self._rejected_permanent:
+            return None
+        step = checkpoint_step(candidate)
+        try:
+            mtime = os.path.getmtime(candidate)
+        except OSError:
+            return None  # vanished between listing and stat
+        if step is not None:
+            if step <= self.service.ckpt_step:
+                return None
+        elif self._promoted_mtime is not None and mtime <= self._promoted_mtime:
+            # foreign filename: "newer" falls back to mtime vs the last
+            # install, mirroring latest_checkpoint's own ordering fallback
+            return None
+        ok, reason, anomalies = checkpoint_health(
+            candidate, health_gate=self.health_gate, allow_unjournaled=self.allow_unjournaled
+        )
+        if not ok:
+            if candidate in self._rejected_logged:
+                return None  # still unhealthy: no reject spam, retry next poll
+            self._rejected_logged.add(candidate)
+            self.service.reject(candidate, reason, anomalies)
+            return False
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(candidate)
+        params = self.service.handle.load_params(state["agent"])
+        promoted = self.service.promote(
+            params, step if step is not None else self.service.ckpt_step, candidate
+        )
+        if not promoted:
+            self._rejected_permanent.add(candidate)
+        else:
+            self._promoted_mtime = mtime
+            self._rejected_logged.discard(candidate)
+        return promoted
+
+
+def _serve_log_dir(cfg) -> str:
+    """Versioned serving run dir (``logs/serve/<run_name>/version_N``) —
+    the same layout training uses, so journal tooling walks both."""
+    base = os.path.join("logs", "serve", str(cfg.get("run_name") or "serve"))
+    os.makedirs(base, exist_ok=True)
+    versions = [
+        int(d.split("_")[1])
+        for d in os.listdir(base)
+        if d.startswith("version_") and d.split("_")[1].isdigit()
+    ]
+    log_dir = os.path.join(base, f"version_{max(versions) + 1 if versions else 0}")
+    os.makedirs(log_dir, exist_ok=True)
+    return log_dir
+
+
+class ServeApp:
+    """Everything the ``serve`` CLI runs: policy + service + HTTP + watcher.
+
+    Built from a composed run config (the checkpoint's archived config with a
+    ``serving`` block merged in — ``cli.serve`` does that).  ``start``
+    returns the bound ``(host, port)``; tests drive it in-process.
+    """
+
+    def __init__(self, cfg, ckpt_path: str, watch_dir: Optional[str] = None):
+        self.cfg = cfg
+        serving_cfg = dict(cfg.get("serving") or {})
+        reload_cfg = dict(serving_cfg.get("reload") or {})
+        self.host = str(serving_cfg.get("host", "127.0.0.1"))
+        self.port = int(serving_cfg.get("port", 0))
+        self.request_timeout_s = float(serving_cfg.get("request_timeout_s", 30.0))
+        self.log_dir = _serve_log_dir(cfg)
+        from sheeprl_tpu.diagnostics.journal import JOURNAL_NAME, RunJournal
+
+        self.journal = RunJournal(os.path.join(self.log_dir, JOURNAL_NAME))
+        self.handle = load_policy(cfg, ckpt_path)
+        self.service = PolicyService(self.handle, serving_cfg, journal=self.journal)
+        self.service.info["env"] = (cfg.get("env") or {}).get("id")
+        self.service.info["run_id"] = os.path.basename(self.log_dir)
+        self.watcher: Optional[CheckpointWatcher] = None
+        if reload_cfg.get("enabled", True):
+            self.watcher = CheckpointWatcher(
+                self.service,
+                watch_dir or reload_cfg.get("watch_dir") or os.path.dirname(os.path.abspath(ckpt_path)),
+                poll_s=float(reload_cfg.get("poll_s", 2.0)),
+                health_gate=bool(reload_cfg.get("health_gate", True)),
+                allow_unjournaled=bool(reload_cfg.get("allow_unjournaled", True)),
+                journal=self.journal,
+                journal_every_s=float(serving_cfg.get("journal_every_s", 10.0)),
+            )
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._warmup = bool(serving_cfg.get("warmup", True))
+
+    def start(self) -> Tuple[str, int]:
+        service = self.service
+        timeout_s = self.request_timeout_s
+        service.start()
+        if self._warmup:
+            service.warmup()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:  # silence stderr spam
+                pass
+
+            def _reply(self, status: int, body: bytes, content_type: str = "application/json") -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self) -> None:  # noqa: N802 - stdlib API
+                if self.path.partition("?")[0] != "/act":
+                    self._reply(404, b'{"error": "not found"}')
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    result = service.act(
+                        payload.get("obs"),
+                        greedy=payload.get("greedy"),
+                        timeout_s=min(timeout_s, float(payload.get("timeout_s") or timeout_s)),
+                    )
+                except ServeError as err:
+                    self._reply(err.status, json.dumps({"error": str(err)}).encode())
+                    return
+                except (ValueError, TypeError, json.JSONDecodeError) as err:
+                    self._reply(400, json.dumps({"error": str(err)}).encode())
+                    return
+                except Exception as err:  # noqa: BLE001 - handler must answer
+                    self._reply(500, json.dumps({"error": repr(err)}).encode())
+                    return
+                body = {
+                    "action": np.asarray(result["action"]).tolist(),
+                    **{k: v for k, v in result.items() if k != "action"},
+                }
+                self._reply(200, json.dumps(body).encode())
+
+            def do_GET(self) -> None:  # noqa: N802 - stdlib API
+                path = self.path.partition("?")[0]
+                try:
+                    if path == "/metrics":
+                        from sheeprl_tpu.diagnostics.metrics_server import PROMETHEUS_CONTENT_TYPE
+
+                        self._reply(
+                            200,
+                            render_serving_metrics(service.snapshot()).encode(),
+                            PROMETHEUS_CONTENT_TYPE,
+                        )
+                    elif path == "/healthz":
+                        stats = service.batcher.stats()
+                        self._reply(
+                            200,
+                            json.dumps(
+                                {
+                                    "status": "ok",
+                                    "algo": service.handle.algo,
+                                    "ckpt_step": service.ckpt_step,
+                                    "ckpt_path": service.ckpt_path,
+                                    "requests_total": stats["requests_total"],
+                                    "last_promote_rejected": service.last_promote_rejected,
+                                }
+                            ).encode(),
+                        )
+                    else:
+                        self._reply(404, b'{"error": "not found"}')
+                except Exception as err:  # noqa: BLE001 - snapshot races
+                    self._reply(500, json.dumps({"error": repr(err)}).encode())
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="sheeprl-serve-http", daemon=True
+        )
+        self._thread.start()
+        if self.watcher is not None:
+            self.watcher.start()
+        host, port = self._server.server_address[:2]
+        self.journal.write(
+            "serve_start",
+            algo=self.handle.algo,
+            env=(self.cfg.get("env") or {}).get("id"),
+            ckpt=self.service.ckpt_path,
+            ckpt_step=self.service.ckpt_step,
+            host=str(host),
+            port=int(port),
+            buckets=list(self.service.buckets),
+            watch_dir=self.watcher.watch_dir if self.watcher is not None else None,
+        )
+        return str(host), int(port)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        assert self._server is not None, "ServeApp not started"
+        host, port = self._server.server_address[:2]
+        return str(host), int(port)
+
+    def close(self, status: str = "completed") -> None:
+        if self.watcher is not None:
+            self.watcher.stop()
+            self.watcher = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.service.close()
+        stats = self.service.batcher.stats()
+        self.journal.write("metrics", step=stats["requests_total"], metrics=self.service.snapshot()["gauges"])
+        self.journal.write("run_end", status=status)
+        self.journal.close()
+
+
+def serve_checkpoint(cfg, ckpt_path: str, watch_dir: Optional[str] = None) -> None:
+    """Blocking CLI driver: start the app, print the address, serve until
+    interrupted."""
+    app = ServeApp(cfg, ckpt_path, watch_dir=watch_dir)
+    host, port = app.start()
+    print(
+        f"Serving {app.handle.algo} checkpoint (step {app.service.ckpt_step}) "
+        f"at http://{host}:{port}/act  (metrics: /metrics, health: /healthz)",
+        flush=True,
+    )
+    status = "completed"
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    except BaseException:
+        status = "aborted"
+        raise
+    finally:
+        app.close(status)

@@ -24,7 +24,12 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
   SIGKILL-then-resume killed-segment e2e — and `test_health.py` —
   in-graph health-stats goldens, every anomaly detector, the
   entropy-collapse CLI drill, the dispatch/fetch-parity e2e and the
-  health_diff red/green fixture pair), plus `tests/test_tools/test_lint.py` (the
+  health_diff red/green fixture pair), the serving suite
+  (`tests/test_serving/`: dynamic-batcher units + the padding-parity
+  golden vs unbatched apply, the hot-reload promotion race and
+  health-gate verdicts, and the train-then-serve CLI e2e — batched
+  `/act` bit-parity, two-clients-one-dispatch amortization, journaled
+  `ckpt_promote`/`ckpt_reject`), plus `tests/test_tools/test_lint.py` (the
   static-analysis framework itself).  The suite is preceded by the full
   `tools/sheeprl_lint.py` run (all pass families: INS instrumentation/
   donation wiring, JIT traced-body purity, CFG config contracts, JRN

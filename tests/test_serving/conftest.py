@@ -1,0 +1,64 @@
+"""Shared fixtures for the serving suite: a host-side fake policy handle
+(no jax in the step — `aot=False` services call it directly), so the batcher
+and hot-reload mechanics are testable deterministically and fast."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serving.loader import PolicyHandle
+
+
+def make_fake_handle(obs_dim: int = 4, version: float = 1.0) -> PolicyHandle:
+    """A policy whose action is ``[params_scalar, row_sum]`` — every response
+    reveals both WHICH params version served it and THAT its own row (not a
+    padding row or a neighbor) was used."""
+    obs_spec = {"state": ((obs_dim,), "float32")}
+
+    def assemble(rows: List[Dict[str, np.ndarray]], width: int) -> np.ndarray:
+        buf = np.zeros((int(width), obs_dim), dtype=np.float32)
+        for i, row in enumerate(rows):
+            buf[i] = row["state"]
+        return buf
+
+    def make_step(greedy: bool):
+        def step(params, obs, key):
+            scalar = np.full((obs.shape[0], 1), params["w"], dtype=np.float32)
+            return np.concatenate([scalar, obs.sum(axis=-1, keepdims=True)], axis=-1)
+
+        return step
+
+    def validate(obs: Any) -> Dict[str, np.ndarray]:
+        if not isinstance(obs, dict) or "state" not in obs:
+            raise ValueError("obs must be a dict with a 'state' key")
+        arr = np.asarray(obs["state"], dtype=np.float32).reshape(-1)
+        if arr.size != obs_dim:
+            raise ValueError(f"state must have {obs_dim} elements")
+        return {"state": arr}
+
+    return PolicyHandle(
+        algo="fake",
+        obs_spec=obs_spec,
+        action_shape=(2,),
+        params={"w": np.float32(version)},
+        make_step=make_step,
+        assemble=assemble,
+        validate=validate,
+        load_params=lambda state: {"w": np.float32(state["w"])},
+    )
+
+
+@pytest.fixture
+def fake_handle() -> PolicyHandle:
+    return make_fake_handle()
+
+
+@pytest.fixture
+def fake_handle_factory():
+    """The builder itself, for tests that need custom dims/versions (test
+    dirs are not packages, so the factory travels as a fixture, not an
+    import)."""
+    return make_fake_handle

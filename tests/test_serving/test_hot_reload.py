@@ -1,0 +1,221 @@
+"""Hot-reload semantics: the promotion race (a swap during an in-flight
+batch must neither mix params within one dispatch nor drop queued requests),
+the health gate over the training journal, and the watcher's promote/reject
+verdicts."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.diagnostics.journal import RunJournal, read_journal
+from sheeprl_tpu.serving.loader import (
+    checkpoint_health,
+    checkpoint_step,
+    latest_checkpoint,
+)
+from sheeprl_tpu.serving.server import CheckpointWatcher, PolicyService
+from sheeprl_tpu.utils.checkpoint import save_state
+
+
+def test_promotion_during_inflight_batch_mixes_nothing_drops_nothing(fake_handle):
+    """The race, deterministically: dispatches are slowed via the injected
+    step delay, a promotion lands WHILE a batch is in flight, and every
+    request still gets exactly one params version — the one its dispatch
+    snapshot — with no request dropped."""
+    svc = PolicyService(fake_handle, {"batch_buckets": [2], "max_delay_ms": 5.0}, aot=False)
+    svc._step_delay_s = 0.25  # snapshot-then-sleep: the promote lands in the gap
+    svc.start()
+    results = []
+    lock = threading.Lock()
+
+    def client(i):
+        r = svc.act({"state": np.full(4, i, np.float32)}, timeout_s=10.0)
+        with lock:
+            results.append(r)
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+        for t in threads[:2]:
+            t.start()
+        # wait until the first dispatch snapshot its params and is sleeping
+        deadline = time.monotonic() + 5.0
+        while svc.batcher.stats()["dispatches_total"] == 0 and svc.batcher.queue_depth() == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        time.sleep(0.05)
+        # queue more behind the in-flight batch, then promote mid-flight
+        for t in threads[2:]:
+            t.start()
+        assert svc.promote({"w": np.float32(2.0)}, 99, "/tmp/ckpt_99_0.ckpt")
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        svc.close()
+
+    # nothing dropped: all 6 requests answered
+    assert len(results) == 6
+    # no dispatch mixed params: within one dispatch_id every row reports the
+    # same params scalar AND the matching params_version
+    by_dispatch = {}
+    for r in results:
+        by_dispatch.setdefault(r["dispatch_id"], []).append(r)
+    for rows in by_dispatch.values():
+        scalars = {float(r["action"][0]) for r in rows}
+        versions = {r["params_version"] for r in rows}
+        assert len(scalars) == 1 and len(versions) == 1
+    # the promotion happened mid-run: old AND new params both served
+    served = {float(r["action"][0]) for r in results}
+    assert served == {1.0, 2.0}
+    # version/params pairing is consistent: v0 -> 1.0, v1 -> 2.0
+    for r in results:
+        expected = 1.0 if r["params_version"] == 0 else 2.0
+        assert float(r["action"][0]) == expected
+
+
+def test_promote_rejects_shape_and_dtype_mismatch(fake_handle):
+    svc = PolicyService(fake_handle, {"batch_buckets": [2]}, aot=False)
+    svc.start()
+    try:
+        assert not svc.promote({"w": np.zeros(3, np.float32)}, 5, "bad.ckpt")
+        assert svc.rejections_total == 1 and svc.last_promote_rejected
+        assert not svc.promote({"wrong_key": np.float32(1)}, 5, "bad2.ckpt")
+        # same shape, different dtype: the AOT executables are specialized
+        # to the old avals — installing this would fail every later dispatch
+        assert not svc.promote({"w": np.float64(2.0)}, 5, "bad3.ckpt")
+        # a later good promotion clears the unhealthy flag
+        assert svc.promote({"w": np.float32(3.0)}, 6, "good_ckpt_6_0.ckpt")
+        assert not svc.last_promote_rejected
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint discovery + health gate
+# ---------------------------------------------------------------------------
+
+
+def _run_dir(tmp_path: Path) -> Path:
+    version = tmp_path / "version_0"
+    (version / "checkpoint").mkdir(parents=True)
+    return version
+
+
+def _write_ckpt(version: Path, step: int, w: float = 1.0) -> str:
+    path = version / "checkpoint" / f"ckpt_{step}_0.ckpt"
+    save_state(str(path), {"agent": {"w": np.float32(w)}})
+    return str(path)
+
+
+def test_watcher_promotes_foreign_filenames_by_mtime(tmp_path, fake_handle):
+    """Registry/standalone artifacts without a ``ckpt_{step}_{rank}`` name
+    still hot-reload: newness falls back to mtime vs the last install."""
+    ckpt_dir = tmp_path / "models"
+    ckpt_dir.mkdir()
+    svc = PolicyService(fake_handle, {"batch_buckets": [2]}, aot=False)
+    svc.start()
+    watcher = CheckpointWatcher(svc, str(ckpt_dir), allow_unjournaled=True)
+    try:
+        foreign = ckpt_dir / "actor.ckpt"
+        save_state(str(foreign), {"agent": {"w": np.float32(7.0)}})
+        assert watcher.check_once() is True
+        assert watcher.check_once() is None  # same mtime: idempotent
+        assert float(svc.act({"state": [0, 0, 0, 0]})["action"][0]) == 7.0
+        # overwritten in place (newer mtime): promoted again
+        time.sleep(0.05)
+        save_state(str(foreign), {"agent": {"w": np.float32(8.0)}})
+        assert watcher.check_once() is True
+        assert float(svc.act({"state": [0, 0, 0, 0]})["action"][0]) == 8.0
+    finally:
+        svc.close()
+
+
+def test_checkpoint_discovery(tmp_path):
+    version = _run_dir(tmp_path)
+    assert latest_checkpoint(str(version / "checkpoint")) is None
+    p16 = _write_ckpt(version, 16)
+    p32 = _write_ckpt(version, 32)
+    assert checkpoint_step(p32) == 32
+    assert checkpoint_step("foreign.ckpt") is None
+    assert latest_checkpoint(str(version / "checkpoint")) == p32
+    assert latest_checkpoint(str(tmp_path / "missing")) is None
+    assert os.path.exists(p16)
+
+
+def test_health_gate_over_the_training_journal(tmp_path):
+    version = _run_dir(tmp_path)
+    ckpt = _write_ckpt(version, 16)
+    # no journal: the override knob decides
+    ok, reason, _ = checkpoint_health(ckpt, allow_unjournaled=True)
+    assert ok
+    ok, _, _ = checkpoint_health(ckpt, allow_unjournaled=False)
+    assert not ok
+    # clean journal: promotable
+    journal = RunJournal(str(version / "journal.jsonl"))
+    journal.write("run_start", algo="fake")
+    journal.sync()
+    ok, reason, _ = checkpoint_health(ckpt)
+    assert ok and reason == "journal clean"
+    # open anomaly: refused, with the offending detector named
+    journal.write("anomaly", kind="entropy_collapse", subject="Loss/entropy_loss", step=8)
+    journal.sync()
+    ok, reason, anomalies = checkpoint_health(ckpt)
+    assert not ok and "entropy_collapse" in reason and len(anomalies) == 1
+    # gate off: promotable regardless
+    ok, _, _ = checkpoint_health(ckpt, health_gate=False)
+    assert ok
+    # anomaly cleared: promotable again
+    journal.write("anomaly_end", kind="entropy_collapse", subject="Loss/entropy_loss", step=12)
+    journal.close()
+    ok, _, _ = checkpoint_health(ckpt)
+    assert ok
+
+
+def test_watcher_promotes_healthy_and_rejects_anomalous(tmp_path, fake_handle):
+    version = _run_dir(tmp_path)
+    ckpt_dir = version / "checkpoint"
+    _write_ckpt(version, 16, w=1.0)
+    serve_journal = RunJournal(str(tmp_path / "serve_journal.jsonl"))
+    svc = PolicyService(fake_handle, {"batch_buckets": [2]}, journal=serve_journal, aot=False)
+    svc.ckpt_step = 16
+    svc.start()
+    watcher = CheckpointWatcher(svc, str(ckpt_dir), journal=serve_journal)
+    try:
+        # nothing newer -> no-op
+        assert watcher.check_once() is None
+        # a newer checkpoint under a clean journal -> exactly one promotion
+        _write_ckpt(version, 32, w=2.0)
+        assert watcher.check_once() is True
+        assert watcher.check_once() is None  # idempotent: same step again
+        assert svc.ckpt_step == 32
+        assert float(svc.act({"state": [0, 0, 0, 0]})["action"][0]) == 2.0
+        # an anomaly-bearing training journal -> reject, once
+        train_journal = RunJournal(str(version / "journal.jsonl"))
+        train_journal.write("anomaly", kind="plateau", subject="Loss/policy_loss", step=40)
+        train_journal.sync()
+        _write_ckpt(version, 48, w=3.0)
+        assert watcher.check_once() is False
+        assert watcher.check_once() is None  # still unhealthy: no reject spam
+        assert svc.ckpt_step == 32  # still serving the last good params
+        # the rejection is RETRYABLE: once the anomaly clears, the same
+        # checkpoint promotes on the next poll (no permanent blacklist)
+        train_journal.write("anomaly_end", kind="plateau", subject="Loss/policy_loss", step=44)
+        train_journal.close()
+        assert watcher.check_once() is True
+        assert svc.ckpt_step == 48
+        assert float(svc.act({"state": [0, 0, 0, 0]})["action"][0]) == 3.0
+        assert not svc.last_promote_rejected
+    finally:
+        svc.close()
+        serve_journal.close()
+    events = read_journal(str(tmp_path / "serve_journal.jsonl"))
+    promotes = [e for e in events if e["event"] == "ckpt_promote"]
+    rejects = [e for e in events if e["event"] == "ckpt_reject"]
+    assert [e["step"] for e in promotes] == [32, 48]
+    assert len(rejects) == 1 and rejects[0]["step"] == 48
+    assert rejects[0]["anomalies"][0]["kind"] == "plateau"

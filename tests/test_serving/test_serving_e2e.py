@@ -1,0 +1,282 @@
+"""Serving acceptance e2e (ISSUE 11): train a tiny ppo run through the REAL
+CLI, serve its checkpoint, and assert
+
+(a) ``POST /act`` actions are bit-identical to a direct ``agent.apply`` on
+    the same observation;
+(b) two concurrent clients amortize into ONE batched dispatch (instrumented
+    dispatch count + batch-width gauge);
+(c) a fresh healthy checkpoint triggers exactly one journaled
+    ``ckpt_promote`` with no recompile, while an anomaly-bearing training
+    journal yields ``ckpt_reject`` (and the run_monitor banner).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+import yaml
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.config import compose_group, deep_merge
+from sheeprl_tpu.diagnostics.journal import read_journal
+from sheeprl_tpu.serving.server import ServeApp
+from sheeprl_tpu.utils.utils import dotdict
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+PPO_TINY = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=1",
+    "metric.log_every=1",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.run_test=False",
+]
+
+
+def _post_act(url: str, obs: dict, **extra) -> dict:
+    payload = json.dumps({"obs": obs, **extra}).encode()
+    with urllib.request.urlopen(urllib.request.Request(url + "/act", data=payload), timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _get_json(url: str, path: str) -> dict:
+    with urllib.request.urlopen(url + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _serve_cfg(ckpt: Path) -> dotdict:
+    """The ``cli.serve`` config merge, inlined so the app runs in-process."""
+    with open(ckpt.parent.parent / "config.yaml") as fp:
+        cfg = dotdict(yaml.safe_load(fp))
+    serving = compose_group("serving", "default")
+    deep_merge(serving, cfg.get("serving") or {})
+    deep_merge(
+        serving,
+        {
+            "batch_buckets": [2, 4],
+            "max_delay_ms": 250.0,
+            "journal_every_s": 0.0,
+            "reload": {"poll_s": 0.1},
+        },
+    )
+    cfg.serving = serving
+    return cfg
+
+
+def _wait_for(predicate, timeout_s: float = 20.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.05)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def test_serve_checkpoint_e2e():
+    run([*PPO_TINY, "dry_run=True", "checkpoint.save_last=True"])
+    (ckpt,) = sorted(Path("logs").rglob("*.ckpt"))
+    train_journal = ckpt.parent.parent / "journal.jsonl"
+    assert train_journal.exists()
+
+    cfg = _serve_cfg(ckpt)
+    app = ServeApp(cfg, str(ckpt))
+    try:
+        host, port = app.start()
+        url = f"http://{host}:{port}"
+        compiles_after_warmup = app.service.compile_count
+        assert compiles_after_warmup == 4  # one AOT executable per (bucket, mode)
+
+        # ---- (a) bit-identical to direct agent.apply --------------------
+        obs_row = (np.arange(10, dtype=np.float32) / 10.0 - 0.5).tolist()
+        response = _post_act(url, {"state": obs_row})
+        assert response["ckpt_step"] == 16
+        assert response["batch_width"] == 2 and response["batch_rows"] == 1
+
+        import jax
+
+        from sheeprl_tpu.algos.ppo.agent import build_agent
+        from sheeprl_tpu.envs.env import make_env
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(str(ckpt))
+        env = make_env(cfg, cfg.seed, 0, None, "test")()
+        agent, params, _ = build_agent(
+            None, (env.action_space.n,), False, cfg, env.observation_space, state["agent"]
+        )
+        env.close()
+        direct, _, _, _ = agent.apply(
+            params,
+            {"state": np.asarray(obs_row, np.float32)[None]},
+            key=jax.random.PRNGKey(0),
+            greedy=True,
+        )
+        assert np.asarray(direct)[0].tolist() == response["action"]
+
+        # ---- (b) two concurrent clients -> ONE batched dispatch ---------
+        d0 = app.service.batcher.stats()["dispatches_total"]
+        barrier = threading.Barrier(2)
+        results = []
+
+        def client(i: int) -> None:
+            barrier.wait()
+            results.append(_post_act(url, {"state": np.full(10, 0.1 * i, np.float32).tolist()}))
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = app.service.batcher.stats()
+        assert stats["dispatches_total"] - d0 == 1, "two clients were not amortized into one dispatch"
+        assert {r["dispatch_id"] for r in results} == {results[0]["dispatch_id"]}
+        assert all(r["batch_rows"] == 2 for r in results)
+        # ...and the /metrics gauge family agrees
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+            metrics_text = resp.read().decode()
+        assert "sheeprl_serve_dispatches_total" in metrics_text
+        assert 'sheeprl_serve_batch_width_total{width="2"}' in metrics_text
+
+        # ---- (c) hot reload: healthy promote, anomalous reject ----------
+        promoted = ckpt.parent / "ckpt_32_0.ckpt"
+        shutil.copyfile(ckpt, promoted)
+        _wait_for(lambda: app.service.ckpt_step == 32, what="healthy promotion")
+        assert app.service.compile_count == compiles_after_warmup, "promotion recompiled"
+        after_promote = _post_act(url, {"state": obs_row})
+        assert after_promote["ckpt_step"] == 32
+        # same params bytes -> same action, through the SAME executables
+        assert after_promote["action"] == response["action"]
+        assert app.service.compile_count == compiles_after_warmup
+
+        # poison the training journal with an open anomaly, then a new ckpt
+        with open(train_journal, "a", encoding="utf-8") as fp:
+            fp.write(
+                json.dumps(
+                    {
+                        "t": time.time(),
+                        "event": "anomaly",
+                        "kind": "entropy_collapse",
+                        "subject": "Loss/entropy_loss",
+                        "step": 40,
+                    }
+                )
+                + "\n"
+            )
+        rejected = ckpt.parent / "ckpt_48_0.ckpt"
+        shutil.copyfile(ckpt, rejected)
+        _wait_for(lambda: app.service.rejections_total >= 1, what="checkpoint rejection")
+        assert app.service.ckpt_step == 32  # still serving the last good one
+        health = _get_json(url, "/healthz")
+        assert health["last_promote_rejected"] is True
+        assert health["ckpt_step"] == 32
+
+        # run_monitor --url recognizes the serving endpoint (satellite):
+        # request gauges + the UNHEALTHY-CKPT banner
+        spec = importlib.util.spec_from_file_location(
+            "run_monitor", REPO_ROOT / "tools" / "run_monitor.py"
+        )
+        run_monitor = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(run_monitor)
+        block = run_monitor.endpoint_status(url)
+        assert "!! UNHEALTHY-CKPT" in block
+        assert "serving" in block and "req/s" in block
+    finally:
+        app.close()
+
+    # the serving journal tells the whole story, in order
+    events = read_journal(os.path.join(app.log_dir, "journal.jsonl"))
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "serve_start" and kinds[-1] == "run_end"
+    promotes = [e for e in events if e["event"] == "ckpt_promote"]
+    rejects = [e for e in events if e["event"] == "ckpt_reject"]
+    assert len(promotes) == 1 and promotes[0]["step"] == 32
+    assert len(rejects) == 1 and rejects[0]["step"] == 48
+    assert rejects[0]["anomalies"][0]["kind"] == "entropy_collapse"
+
+
+def test_serve_cli_subprocess_smoke():
+    """The real entrypoint wiring: ``tools/serve.py checkpoint_path=...``
+    comes up, prints its address, answers /healthz and /act, and shuts down
+    cleanly on SIGINT."""
+    import signal
+    import subprocess
+
+    run([*PPO_TINY, "dry_run=True", "checkpoint.save_last=True"])
+    (ckpt,) = sorted(Path("logs").rglob("*.ckpt"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            str(REPO_ROOT / "tools" / "serve.py"),
+            f"checkpoint_path={ckpt}",
+            "serving.port=0",
+            "serving.batch_buckets=[2]",
+            "serving.reload.enabled=False",
+            "fabric.accelerator=cpu",
+        ],
+        cwd=os.getcwd(),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    try:
+        line = _wait_for_line(proc, "Serving ", timeout_s=240)
+        url = line.split("at ", 1)[1].split("/act", 1)[0]
+        health = _get_json(url, "/healthz")
+        assert health["status"] == "ok" and health["algo"] == "ppo"
+        response = _post_act(url, {"state": np.zeros(10, np.float32).tolist()})
+        assert len(response["action"]) == 1
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGINT)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=30)
+
+
+def _wait_for_line(proc, prefix: str, timeout_s: float):
+    deadline = time.monotonic() + timeout_s
+    collected = []
+
+    def reader():
+        for line in proc.stdout:
+            collected.append(line)
+            if line.startswith(prefix):
+                return
+
+    thread = threading.Thread(target=reader, daemon=True)
+    thread.start()
+    while time.monotonic() < deadline:
+        for line in collected:
+            if line.startswith(prefix):
+                return line.strip()
+        if proc.poll() is not None:
+            pytest.fail(f"serve subprocess exited early (rc={proc.returncode}): {''.join(collected)[-2000:]}")
+        time.sleep(0.2)
+    pytest.fail(f"serve subprocess never printed {prefix!r}: {''.join(collected)[-2000:]}")

@@ -392,6 +392,31 @@ class X:
     assert "mystery_kind" in findings[0].message
 
 
+def test_jrn_covers_serving_package_emitters():
+    """The serving tier journals from OUTSIDE the diagnostics package
+    (``sheeprl_tpu/serving/server.py``): JRN301 must still police its kinds —
+    the emitter scan is tree-wide — while metric literals there are exempt
+    (rule 3 is scoped to the diagnostics package)."""
+    emitter = """\
+class PolicyService:
+    def promote(self):
+        self._journal.write("ok_event", step=1)
+        self._journal.write("ckpt_promote_typo", step=1)
+        name = "sheeprl_serve_not_a_registered_metric"
+"""
+    findings = jrn_pass.run(
+        RepoIndex.from_sources(
+            {
+                "sheeprl_tpu/diagnostics/schema.py": JRN_SCHEMA,
+                "sheeprl_tpu/serving/server.py": emitter,
+                "howto/diagnostics.md": JRN_DOC_OK,
+            }
+        )
+    )
+    assert {f.rule for f in findings} == {"JRN301"}
+    assert "ckpt_promote_typo" in findings[0].message
+
+
 def test_jrn_doc_table_sync_both_directions():
     emitter = 'class X:\n    def go(self):\n        self._journal("ok_event")\n'
     # missing kind: table omits ok_event

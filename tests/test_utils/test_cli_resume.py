@@ -1,0 +1,97 @@
+"""``cli.resume_from_checkpoint`` override semantics: the archived config is
+the base; ``diagnostics`` and ``env`` are overridable on resume — but only
+the dotted keys the user explicitly passed, so archived settings the user
+did not re-type keep their values (ISSUE 11 satellite)."""
+
+from __future__ import annotations
+
+import yaml
+
+from sheeprl_tpu.cli import resume_from_checkpoint
+from sheeprl_tpu.config import compose
+
+import pytest
+
+TINY = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "algo.dense_units=8",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+]
+
+# the archived run carries non-default env/diagnostics settings the resume
+# commands below deliberately do NOT repeat
+ARCHIVE_EXTRAS = [
+    "env.num_envs=4",
+    "env.capture_video=False",
+    "diagnostics.sentinel.enabled=True",
+]
+
+
+def _archive_run(tmp_path):
+    cfg = compose([*TINY, *ARCHIVE_EXTRAS])
+    version = tmp_path / "version_0"
+    (version / "checkpoint").mkdir(parents=True)
+    with open(version / "config.yaml", "w") as fp:
+        yaml.safe_dump(cfg.as_dict(), fp)
+    ckpt = version / "checkpoint" / "ckpt_16_0.ckpt"
+    ckpt.write_bytes(b"")
+    return cfg, ckpt
+
+
+def test_resume_allows_diagnostics_and_env_overrides(tmp_path):
+    archived, ckpt = _archive_run(tmp_path)
+    overrides = [
+        *TINY,
+        f"checkpoint.resume_from={ckpt}",
+        "env.num_envs=8",
+        "diagnostics.goodput.watchdog.stall_threshold_s=999.0",
+        "diagnostics.compilation_cache_dir=compile_cache",
+    ]
+    merged = resume_from_checkpoint(compose(overrides), overrides)
+    # diagnostics: a resumed run can retune its observability layer
+    assert merged.diagnostics.goodput.watchdog.stall_threshold_s == 999.0
+    assert merged.diagnostics.compilation_cache_dir == "compile_cache"
+    # env host knobs: overridable (the env *identity* stays pinned below)
+    assert merged.env.num_envs == 8
+    # resume bookkeeping unchanged
+    assert merged.checkpoint.resume_from == str(ckpt)
+    assert merged.root_dir == archived.root_dir
+
+
+def test_resume_preserves_archived_env_and_diagnostics_not_retyped(tmp_path):
+    """Only EXPLICIT overrides land: archived non-default env/diagnostics
+    values the resume command does not mention must survive (a whole-block
+    replacement would silently revert them to group defaults)."""
+    _, ckpt = _archive_run(tmp_path)
+    overrides = [
+        *TINY,
+        f"checkpoint.resume_from={ckpt}",
+        "diagnostics.compilation_cache_dir=compile_cache",
+    ]
+    merged = resume_from_checkpoint(compose(overrides), overrides)
+    assert merged.env.num_envs == 4  # archived, not the composed default
+    assert merged.env.capture_video is False
+    assert merged.diagnostics.sentinel.enabled is True
+    assert merged.diagnostics.compilation_cache_dir == "compile_cache"
+
+
+def test_resume_still_pins_env_identity(tmp_path):
+    _, ckpt = _archive_run(tmp_path)
+    overrides = [
+        *[o for o in TINY if not o.startswith("env.id=")],
+        "env.id=continuous_dummy",
+        f"checkpoint.resume_from={ckpt}",
+    ]
+    with pytest.raises(ValueError, match="different environment"):
+        resume_from_checkpoint(compose(overrides), overrides)
+
+
+def test_resume_keeps_archived_values_for_disallowed_keys(tmp_path):
+    _, ckpt = _archive_run(tmp_path)
+    overrides = [*TINY, f"checkpoint.resume_from={ckpt}", "algo.dense_units=512"]
+    merged = resume_from_checkpoint(compose(overrides), overrides)
+    # algo is NOT in the allowed set: the checkpoint's architecture wins
+    assert merged.algo.dense_units == 8

@@ -7,7 +7,11 @@ Two sources, same view:
   works on any run, local or NFS-mounted, no ports needed;
 * **endpoint mode** (``--url http://host:port``): poll the run's live
   ``/metrics`` endpoint (``diagnostics.telemetry.http.enabled=True``) — works
-  across machines without filesystem access.
+  across machines without filesystem access.  A *serving* endpoint
+  (``tools/serve.py``) is recognized by its ``sheeprl_serve_*`` family and
+  renders the request panel instead (req/s, p50/p99 latency, batch width,
+  queue depth, promotion counters — with an ``!! UNHEALTHY-CKPT`` banner
+  while the last checkpoint promotion was rejected).
 
 Shows run identity and state, the latest metric interval (reward, SPS, env
 throughput — env-steps/s + fetch amortization — TFLOP/s, MFU, phase
@@ -146,6 +150,43 @@ def endpoint_status(url: str) -> str:
         if lag is not None:
             banner += f" (journal lag {lag:.0f}s)"
         lines.append(banner)
+    if metrics.get("sheeprl_serve_requests_total") is not None:
+        # a serving endpoint (tools/serve.py), not a training run: request
+        # gauges instead of train telemetry, plus the promotion-health banner
+        if metrics.get("sheeprl_serve_last_promote_rejected"):
+            lines.append(
+                "!! UNHEALTHY-CKPT — the last checkpoint promotion was rejected "
+                "(health gate / shape mismatch); still serving "
+                f"step {metrics.get('sheeprl_serve_ckpt_step', 0):g}"
+            )
+        serve_parts = []
+        for key, label, fmt in (
+            ("sheeprl_serve_ckpt_step", "ckpt-step", "{:g}"),
+            ("sheeprl_serve_requests_per_sec", "req/s", "{:.1f}"),
+            ("sheeprl_serve_latency_p50_ms", "p50", "{:.1f}ms"),
+            ("sheeprl_serve_latency_p99_ms", "p99", "{:.1f}ms"),
+            ("sheeprl_serve_batch_width_mean", "batch", "{:.1f}"),
+            ("sheeprl_serve_queue_depth", "queue", "{:.0f}"),
+        ):
+            value = metrics.get(key)
+            if value is not None:
+                serve_parts.append(f"{label} {fmt.format(value)}")
+        if serve_parts:
+            lines.append("serving " + "  ".join(serve_parts))
+        serve_counters = []
+        for key, label in (
+            ("sheeprl_serve_requests_total", "requests"),
+            ("sheeprl_serve_dispatches_total", "dispatches"),
+            ("sheeprl_serve_request_errors_total", "errors"),
+            ("sheeprl_serve_ckpt_promotions_total", "promotions"),
+            ("sheeprl_serve_ckpt_rejections_total", "rejections"),
+        ):
+            value = metrics.get(key)
+            if value is not None:
+                serve_counters.append(f"{value:g} {label}")
+        if serve_counters:
+            lines.append("totals  " + " · ".join(serve_counters))
+        return "\n".join(lines)
     active_anomalies = metrics.get("sheeprl_health_anomalies")
     if active_anomalies:
         info = metrics["_labels"].get("sheeprl_run_info") or []

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Serve a trained checkpoint behind the batched inference tier.
+
+Thin launcher over ``sheeprl_tpu.cli.serve`` (same overrides), runnable
+straight from a checkout:
+
+    python tools/serve.py checkpoint_path=logs/runs/ppo/.../ckpt_16_0.ckpt \
+        serving.port=8080 serving.max_delay_ms=5
+
+    curl -s -X POST http://127.0.0.1:8080/act \
+        -d '{"obs": {"state": [0.1, 0.2, 0.3, 0.4]}}'
+
+See ``howto/serving.md`` for the architecture, bucket tuning and the
+health-gated hot-reload semantics; point ``tools/run_monitor.py --url`` at
+the server for a live dashboard.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# runnable straight from a checkout: tools/ is not a package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.cli import serve  # noqa: E402
+
+if __name__ == "__main__":
+    serve(sys.argv[1:])
