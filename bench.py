@@ -151,6 +151,19 @@ def build_train_step_and_batch(
         batch[k] = jnp.asarray(rng.integers(0, 255, (T, B, 3, 64, 64)), jnp.float32) / 255.0 - 0.5
     for k in set(cfg.algo.mlp_keys.encoder) | set(cfg.algo.mlp_keys.decoder):
         batch[k] = jnp.asarray(rng.normal(size=(T, B, 10)), jnp.float32)
+    from sheeprl_tpu.algos.dreamer_v3.utils import rssm_scan_spec
+
+    if rssm_scan_spec(cfg)[0] > 1:
+        # chunked-scan variants consume replay-stored RSSM states; synthetic
+        # stand-ins keep the compiled graph and its shapes honest (values
+        # only matter for convergence, not for the perf measurement)
+        recurrent_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
+        stoch_flat = cfg.algo.world_model.stochastic_size * cfg.algo.world_model.discrete_size
+        batch["rssm_recurrent"] = jnp.asarray(
+            rng.normal(size=(T, B, recurrent_size)) * 0.01, jnp.float32
+        )
+        batch["rssm_posterior"] = jnp.zeros((T, B, stoch_flat), jnp.float32)
+        batch["rssm_valid"] = jnp.ones((T, B, 1), jnp.float32)
     state = {"params": params, "opt_states": opt_states, "moments_state": moments_state}
     return cfg, train_step, state, batch
 
@@ -233,6 +246,105 @@ def measure_compute(
         out["timing_suspect"] = (
             "implied FLOP/s exceeds chip peak — treat compute timing as unreliable"
         )
+    return out
+
+
+#: The PERF.md §4 MFU levers as config-override variants; `mfu_levers`
+#: sweeps them against the base graph.  rssm_chunks folds the chunk axis
+#: into the batch axis (GRU GEMM at B*K rows), scan_unroll amortizes scan
+#: overhead, rssm_pallas routes the recurrent cell through the fused
+#: LayerNorm-GRU Pallas kernel (XL shapes are where XLA fusion may lose).
+MFU_LEVER_VARIANTS = {
+    "base": [],
+    "rssm_chunks2": ["algo.rssm_chunks=2"],
+    "rssm_chunks4": ["algo.rssm_chunks=4"],
+    "unroll8": ["algo.scan_unroll=8"],
+    "pallas": ["algo.rssm_pallas=True"],
+}
+
+
+def measure_mfu_levers(
+    precision: str,
+    size: str = "S",
+    batch_size: int = 16,
+    sequence_length: int = 64,
+    warmup_steps: int = 2,
+    measure_steps: int = 8,
+    variants=None,
+):
+    """The scan-lever close-out sweep (ROADMAP item 2): step time of the DV3
+    train step under each MFU lever vs the base graph, one variant at a time
+    (build → warm → time → free, so HBM holds ONE variant's state — unlike
+    the interleaved perf_study harness this is a coarse menu stage; for
+    drift-proof A/Bs on a congested tunnel use
+    ``tools/perf_study.py --unroll-ab``).
+
+    Reports ``step_ms`` per variant and the speedup vs base.  Deliberately
+    NOT MFU per variant: ``cost_analysis()`` FLOPs inflate under unrolled
+    scans (PERF.md §4), so step time on the identical batch is the only
+    honest cross-variant number — the note field says so in the JSON.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    variants = dict(MFU_LEVER_VARIANTS) if variants is None else dict(variants)
+    out = {
+        "size": size,
+        "batch_size": batch_size,
+        "sequence_length": sequence_length,
+        "measure_steps": measure_steps,
+        "note": (
+            "step_ms on the identical batch is the cross-variant metric; "
+            "cost_analysis FLOPs (and therefore MFU) inflate under unrolled "
+            "scans, and chunked variants change the stored-state batch keys"
+        ),
+        "points": {},
+    }
+    base_step_s = None
+    for name, extra in variants.items():
+        try:
+            cfg, train_step, state, batch = build_train_step_and_batch(
+                precision,
+                size=size,
+                batch_size=batch_size,
+                sequence_length=sequence_length,
+                extra_overrides=list(extra),
+            )
+            params, opt_states, moments_state = (
+                state["params"],
+                state["opt_states"],
+                state["moments_state"],
+            )
+            key = jax.random.PRNGKey(0)
+            tau = jnp.float32(0.02)
+            for _ in range(warmup_steps):
+                key, sub = jax.random.split(key)
+                params, opt_states, moments_state, metrics = train_step(
+                    params, opt_states, moments_state, batch, sub, tau
+                )[:4]
+            np.asarray(metrics)  # compile + warmup barrier
+            t0 = time.perf_counter()
+            for _ in range(measure_steps):
+                key, sub = jax.random.split(key)
+                params, opt_states, moments_state, metrics = train_step(
+                    params, opt_states, moments_state, batch, sub, tau
+                )[:4]
+            final = np.asarray(metrics)  # value barrier forces the chain
+            step_s = (time.perf_counter() - t0) / measure_steps
+            point = {"step_ms": round(step_s * 1e3, 2), "finite": bool(np.isfinite(final).all())}
+            if name == "base":
+                base_step_s = step_s
+            elif base_step_s:
+                point["vs_base"] = round(base_step_s / step_s, 4)
+            out["points"][name] = point
+        except Exception as err:  # noqa: BLE001 — one variant must not kill the sweep
+            out["points"][name] = {"error": repr(err)[:200]}
+        finally:
+            # drop this variant's params/opt state/batch references before
+            # the next build — at XL shapes two variants do not co-reside in
+            # HBM (rebinding to None releases the arrays to the allocator)
+            params = opt_states = moments_state = batch = state = metrics = None
     return out
 
 
@@ -1005,6 +1117,35 @@ def _run_cpu_fallback(record: dict, precision: str) -> None:
         )
     except Exception as err:  # noqa: BLE001
         record.setdefault("stage_errors", {})["env_scale"] = repr(err)
+    # MFU-lever sweep, smallest point (ROADMAP item 2): base vs rssm_chunks=2
+    # on the XS vector workload — a liveness proof that the chunked graph
+    # compiles, trains finite and lands its JSON fields; chip truth for the
+    # full lever menu comes from the chip-menu stage at XL shapes
+    try:
+        record["mfu_levers"] = measure_mfu_levers(
+            precision,
+            size="XS",
+            batch_size=4,
+            sequence_length=16,
+            measure_steps=4,
+            variants={
+                "base": [
+                    "algo.cnn_keys.encoder=[]",
+                    "algo.cnn_keys.decoder=[]",
+                    "algo.mlp_keys.encoder=[state]",
+                    "algo.mlp_keys.decoder=[state]",
+                ],
+                "rssm_chunks2": [
+                    "algo.cnn_keys.encoder=[]",
+                    "algo.cnn_keys.decoder=[]",
+                    "algo.mlp_keys.encoder=[state]",
+                    "algo.mlp_keys.decoder=[state]",
+                    "algo.rssm_chunks=2",
+                ],
+            },
+        )
+    except Exception as err:  # noqa: BLE001
+        record.setdefault("stage_errors", {})["mfu_levers"] = repr(err)
     # learn-health block (ISSUE 9): sourced from a tiny CLI drill run's own
     # journal — informational, lands on the fallback path too
     try:
@@ -1085,6 +1226,17 @@ def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
     if env_scale:
         record["env_scale"] = env_scale
 
+    # MFU-lever sweep (ROADMAP item 2 close-out): chunked RSSM scan at 2/4
+    # chunks, scan_unroll=8 and the Pallas LN-GRU, each vs the base graph at
+    # XL shapes (where the levers matter; PERF.md §4's table is S/XL)
+    mfu_levers = stage(
+        "mfu_levers",
+        300,
+        lambda: measure_mfu_levers(precision, size="XL", batch_size=16, measure_steps=6),
+    )
+    if mfu_levers:
+        record["mfu_levers"] = mfu_levers
+
     # north-star config (BASELINE.md §C): XL single-chip compute + MFU, at the
     # reference batch (16) and at the MXU-saturating batch (64)
     xl = stage("XL_b16", 240, lambda: measure_compute(precision, size="XL", batch_size=16, measure_steps=40))
@@ -1161,6 +1313,12 @@ def main() -> None:
         # path (measure_serving; the CPU fallback runs the smallest load).
         # Null when the stage was skipped or failed.
         "serving": None,
+        # MFU-lever sweep (ROADMAP item 2 close-out): per-variant step_ms for
+        # the chunked RSSM scan (rssm_chunks 2/4), scan_unroll=8 and the
+        # Pallas LN-GRU vs the base graph (measure_mfu_levers; chip menu runs
+        # it at XL shapes, the CPU fallback runs the smallest base-vs-chunks2
+        # point).  Null when the stage was skipped or failed.
+        "mfu_levers": None,
     }
     emitted = False
 

@@ -873,7 +873,13 @@ def build_agent(
         eps=eps,
         learnable_initial_recurrent_state=wm_cfg.learnable_initial_recurrent_state,
         decoupled_rssm=wm_cfg.decoupled_rssm,
-        fused_gru=wm_cfg.recurrent_model.get("fused_kernel", False),
+        # Pallas fused LayerNorm-GRU: `algo.rssm_pallas` is the deploy-time
+        # lever (bench.py mfu_levers sweeps it); the older
+        # recurrent_model.fused_kernel spelling still works
+        fused_gru=bool(
+            cfg.algo.get("rssm_pallas", False)
+            or wm_cfg.recurrent_model.get("fused_kernel", False)
+        ),
     )
     actor_def = resolve_actor_cls(actor_cfg)(
         latent_state_size=latent_state_size,

@@ -38,17 +38,19 @@ from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401
     AGGREGATOR_KEYS,
     MODELS_TO_REGISTER,
+    chunked_dynamic_scan,
     init_moments_state,
     prepare_obs,
+    rssm_scan_spec,
     test,
     update_moments,
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.factory import make_dreamer_replay_buffer
 from sheeprl_tpu.diagnostics.health import mean_stats
-from sheeprl_tpu.data.slab import step_slab
+from sheeprl_tpu.data.slab import rssm_state_slab, step_slab
 from sheeprl_tpu.envs.env import make_env_fns, pipelined_vector_env
-from sheeprl_tpu.envs.player import obs_sharding
+from sheeprl_tpu.envs.player import fetch_values, obs_sharding
 from sheeprl_tpu.ops.distributions import (
     Bernoulli,
     MSEDistribution,
@@ -91,10 +93,18 @@ def make_train_step(
     horizon = cfg.algo.horizon
     # lax.scan unroll factor for the RSSM/imagination loops: unrolling
     # amortizes per-iteration scan overhead (one S-size sweep on v5e showed
-    # ~6% at unroll=8, but follow-up A/Bs could not confirm it beyond tunnel
-    # noise — PERF.md §4) at the cost of ~unroll x longer compiles, so it
-    # defaults to 1 and is a deploy-time knob
+    # ~6% at unroll=8, and the interleaved A/B harness — tools/perf_study.py
+    # measure_unroll_ab — is how to (re)confirm it on a given chip; PERF.md
+    # §4) at the cost of ~unroll x longer compiles, so it defaults to 1 and
+    # is a deploy-time knob.  Caveat: cost_analysis() FLOPs inflate under
+    # unrolling, so compare step_ms — the telemetry_cost journal event
+    # carries this caveat (cost_note) whenever unroll > 1.
     scan_unroll = int(cfg.algo.get("scan_unroll", 1))
+    # chunked sequence-parallel RSSM scan (PERF.md §4): split the T-step
+    # dynamic-learning scan into K chunks seeded from replay-stored states
+    # and fold the chunk axis into the batch axis — the GRU GEMM then runs at
+    # B*K rows.  rssm_chunks=1 is bit-identical to the sequential scan.
+    rssm_chunks, rssm_burn_in = rssm_scan_spec(cfg)
     gamma = cfg.algo.gamma
     cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
     mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
@@ -148,10 +158,21 @@ def make_train_step(
                 )
                 return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
-            keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
-            _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
-                scan_body, init, (batch_actions, embedded, is_first, keys_t), unroll=scan_unroll
+            recurrents, posteriors, post_logits, prior_logits = chunked_dynamic_scan(
+                scan_body,
+                batch_actions,
+                embedded,
+                is_first,
+                k_wm,
+                stoch_flat=stoch_flat,
+                recurrent_size=recurrent_size,
+                cdt=cdt,
+                chunks=rssm_chunks,
+                burn_in=rssm_burn_in,
+                stored_recurrent=batch.get("rssm_recurrent"),
+                stored_posterior=batch.get("rssm_posterior"),
+                stored_valid=batch.get("rssm_valid"),
+                unroll=scan_unroll,
             )
             latents = jnp.concatenate([posteriors, recurrents], axis=-1)
             recon = world_model_def.apply(wm_params, latents, method="decode")
@@ -548,6 +569,7 @@ def _dreamer_main(
     # recompile watchdog + exact compiled-step FLOPs for the live MFU gauge.
     # The player forward stays uninstrumented — its compiles are still counted
     # by the process-wide jax.monitoring listener.
+    loop_scan_unroll = int(cfg.algo.get("scan_unroll", 1) or 1)
     train_step = diag.instrument(
         "train_step",
         make_train_step_fn(
@@ -562,6 +584,16 @@ def _dreamer_main(
         ),
         kind="train",
         donate_argnums=(0, 1, 2),  # params, opt_states, moments — audited at first dispatch
+        # unrolled scans inflate cost_analysis() FLOPs (PERF.md §4), which
+        # would silently inflate Telemetry/mfu too — the telemetry_cost
+        # journal event carries this caveat so MFU readers know to compare
+        # step_ms instead
+        cost_note=(
+            f"cost_analysis FLOPs inflate under scan unrolling (scan_unroll={loop_scan_unroll}); "
+            "compare step_ms, not MFU"
+            if loop_scan_unroll > 1
+            else None
+        ),
     )
     diag.register_footprint("params", params)
     diag.register_footprint("opt_state", opt_states)
@@ -589,6 +621,21 @@ def _dreamer_main(
         and buffer_state.get("rb") is not None
     ):
         rb.load_state_dict(buffer_state["rb"])
+        if rssm_scan_spec(cfg)[0] > 1:
+            # a replay collected WITHOUT the chunked scan has no stored-state
+            # rows — fail with the cause here instead of a generic
+            # unknown-buffer-key error at the first add
+            loaded = getattr(rb, "buffer", None)
+            if isinstance(loaded, (list, tuple)) and loaded:
+                loaded = loaded[0]
+            loaded_keys = set(loaded.buffer if hasattr(loaded, "buffer") else loaded or {})
+            if loaded_keys and "rssm_recurrent" not in loaded_keys:
+                raise ValueError(
+                    "algo.rssm_chunks > 1 needs replay rows carrying the player's RSSM "
+                    "state (rssm_recurrent/rssm_posterior/rssm_valid), but the restored "
+                    "buffer was collected without them — resume with rssm_chunks=1 or "
+                    "start a fresh buffer"
+                )
 
     train_step_count = 0
     last_train = 0
@@ -616,6 +663,24 @@ def _dreamer_main(
     step_data["terminated"] = np.zeros((1, num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
     player.init_states(params["world_model"])
+
+    # chunked-scan stored states (algo.rssm_chunks > 1): every replay row
+    # additionally carries the player's post-step RSSM state so the train
+    # step can seed chunk boundaries from it (rssm_valid=0 on rows written
+    # without one — prefill, bookkeeping — falls back to the learned initial
+    # state).  Costs H+Z floats per step per env in replay and rides the
+    # iteration's ONE blocking d2h on the host-buffer path.
+    store_rssm_state = rssm_scan_spec(cfg)[0] > 1
+    if store_rssm_state:
+        rssm_zero_recurrent = np.zeros(
+            (num_envs, int(player.state["recurrent"].shape[-1])), np.float32
+        )
+        rssm_zero_stochastic = np.zeros(
+            (num_envs, int(player.state["stochastic"].shape[-1])), np.float32
+        )
+        step_data.update(
+            rssm_state_slab(num_envs, rssm_zero_recurrent, rssm_zero_stochastic, valid=False)
+        )
 
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
@@ -667,6 +732,15 @@ def _dreamer_main(
                         axis=-1,
                     )
                 step_data["actions"] = actions.reshape(1, num_envs, -1)
+                if store_rssm_state:
+                    # prefill rows: the player never ran, so no state exists —
+                    # valid=0 makes chunk starts here reset to the learned
+                    # initial state instead of training on zeros
+                    step_data.update(
+                        rssm_state_slab(
+                            num_envs, rssm_zero_recurrent, rssm_zero_stochastic, valid=False
+                        )
+                    )
             else:
                 rng_key, step_key = jax.random.split(rng_key)
                 torch_obs = prepare_obs(
@@ -681,11 +755,31 @@ def _dreamer_main(
                 )
                 if use_device_buffer:
                     # device-resident actions go straight into the HBM ring
-                    # (no fetch needed for the write)
+                    # (no fetch needed for the write); the chunked-scan state
+                    # record stays on device with them
                     step_data["actions"] = jnp.reshape(actions_jnp, (1, num_envs, -1))
+                    if store_rssm_state:
+                        step_data.update(
+                            rssm_state_slab(
+                                num_envs,
+                                player.state["recurrent"],
+                                player.state["stochastic"],
+                                valid=True,
+                            )
+                        )
                     rb.add(step_data, validate_args=cfg.buffer.validate_args)
                 diag.note_fetch()  # the iteration's ONE blocking d2h
-                actions = np.asarray(actions_jnp)  # blocking value fetch
+                if store_rssm_state and not use_device_buffer:
+                    # the stored states ride the SAME blocking fetch as the
+                    # action values — still one d2h round trip per vector step
+                    actions, host_recurrent, host_stochastic = fetch_values(
+                        actions_jnp, player.state["recurrent"], player.state["stochastic"]
+                    )
+                    step_data.update(
+                        rssm_state_slab(num_envs, host_recurrent, host_stochastic, valid=True)
+                    )
+                else:
+                    actions = np.asarray(actions_jnp)  # blocking value fetch
                 real_actions = split_real_actions(actions)
                 if not use_device_buffer:
                     step_data["actions"] = actions.reshape(1, num_envs, -1)
@@ -809,6 +903,17 @@ def _dreamer_main(
             reset_data["actions"] = np.zeros((1, len(dones_idxes), int(sum(actions_dim))), np.float32)
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            if store_rssm_state:
+                # episode-end bookkeeping rows carry no player state (the env
+                # just reset); valid=0 keeps chunk starts off them
+                reset_data.update(
+                    rssm_state_slab(
+                        len(dones_idxes),
+                        rssm_zero_recurrent[: len(dones_idxes)],
+                        rssm_zero_stochastic[: len(dones_idxes)],
+                        valid=False,
+                    )
+                )
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
 
             step_data["rewards"][:, dones_idxes] = 0

@@ -16,6 +16,164 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+#: Replay keys carrying the player's post-step RSSM state when
+#: ``algo.rssm_chunks > 1`` (SEED-RL/R2D2-style stored-state chunking):
+#: ``rssm_recurrent``/``rssm_posterior`` are the state AFTER observing the
+#: row's obs, ``rssm_valid`` is 1.0 only on rows the player actually wrote
+#: (prefill and episode-end bookkeeping rows carry zeros + valid=0, and a
+#: chunk starting there falls back to the learned initial state — exactly
+#: what the unchunked scan does at every sampled-sequence start).
+RSSM_STATE_KEYS = ("rssm_recurrent", "rssm_posterior", "rssm_valid")
+
+
+def rssm_scan_spec(cfg) -> Tuple[int, int]:
+    """``(chunks, burn_in)`` from ``algo.rssm_chunks`` /
+    ``algo.rssm_chunk_burn_in`` — shared by the DV3/JEPA/P2E train-step
+    builders so the three can never drift.  Configs without the keys (the
+    DV1/DV2 family) resolve to ``(1, 0)`` = today's sequential scan."""
+    chunks = int(cfg.algo.get("rssm_chunks", 1) or 1)
+    burn_in = int(cfg.algo.get("rssm_chunk_burn_in", 0) or 0)
+    if chunks < 1:
+        raise ValueError(f"algo.rssm_chunks must be >= 1, got {chunks}")
+    if burn_in < 0:
+        raise ValueError(f"algo.rssm_chunk_burn_in must be >= 0, got {burn_in}")
+    return chunks, burn_in
+
+
+def chunked_dynamic_scan(
+    scan_body,
+    batch_actions: jax.Array,
+    embedded: jax.Array,
+    is_first: jax.Array,
+    key: jax.Array,
+    *,
+    stoch_flat: int,
+    recurrent_size: int,
+    cdt,
+    chunks: int = 1,
+    burn_in: int = 0,
+    stored_recurrent: jax.Array | None = None,
+    stored_posterior: jax.Array | None = None,
+    stored_valid: jax.Array | None = None,
+    unroll: int = 1,
+):
+    """Run the T-step dynamic-learning scan, optionally split into ``chunks``
+    independent chunks whose initial states come from replay-stored RSSM
+    states — the chunk axis is folded into the batch axis, so the GRU GEMM
+    runs at ``B * chunks`` rows instead of ``B`` (PERF.md §4: MFU rises
+    exactly as the effective row count widens; the trade is strict recurrence
+    across chunk boundaries for stored — possibly stale — states, the
+    SEED-RL/R2D2 playbook).
+
+    ``scan_body`` is the per-step body the callers already wrote:
+    ``((posterior, recurrent), (action_t, embed_t, is_first_t, key_t)) ->
+    ((posterior, recurrent), ys)``.  Returns the stacked ``ys`` pytree in the
+    original ``[T, B, ...]`` layout.
+
+    * ``chunks == 1`` reproduces today's sequential scan **bit-identically**
+      (same zero init, same ``jax.random.split(key, T)`` per-step keys, same
+      op order — golden-tested in ``tests/test_algos/test_rssm_chunks.py``).
+    * ``chunks > 1``: row ``t`` of chunk ``k`` starts at ``t0 = k*T/K``; its
+      initial carry is the stored state at row ``t0 - 1`` (chunk 0 keeps the
+      zero init + forced ``is_first``).  A stored state marked invalid
+      (``rssm_valid == 0``) turns the chunk start into a fresh-sequence start
+      via the ``is_first`` reset path.
+    * ``burn_in > 0``: before the gradient region, rows ``[t0 - burn_in, t0)``
+      are re-run from the state stored at ``t0 - burn_in - 1`` and the
+      resulting carry — gradients stopped — re-freshens each chunk's initial
+      state (R2D2's burn-in, folded over chunks the same way).
+    """
+    T, B = batch_actions.shape[:2]
+    if chunks <= 1:
+        keys_t = jax.random.split(key, T)
+        init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
+        _, ys = jax.lax.scan(
+            scan_body, init, (batch_actions, embedded, is_first, keys_t), unroll=unroll
+        )
+        return ys
+
+    K = int(chunks)
+    if T % K != 0:
+        raise ValueError(f"algo.rssm_chunks ({K}) must divide the sequence length ({T})")
+    C = T // K
+    if not 0 <= burn_in < C:
+        raise ValueError(
+            f"algo.rssm_chunk_burn_in ({burn_in}) must be in [0, chunk_length) = [0, {C})"
+        )
+    if stored_recurrent is None or stored_posterior is None:
+        raise ValueError(
+            "algo.rssm_chunks > 1 needs the replay-stored RSSM state keys "
+            f"{RSSM_STATE_KEYS[:2]} in the batch (enabled automatically by the "
+            "training loop when the knob is set — old replay checkpoints "
+            "collected without it cannot be chunk-trained)"
+        )
+
+    def fold(x):  # [T, B, ...] -> [C, K*B, ...] (row t = k*C + c -> (c, k*B+b))
+        x = x.reshape((K, C) + x.shape[1:])
+        x = jnp.moveaxis(x, 0, 1)
+        return x.reshape((C, K * B) + x.shape[3:])
+
+    def unfold(y):  # inverse of fold on the stacked outputs
+        y = y.reshape((C, K, B) + y.shape[2:])
+        y = jnp.moveaxis(y, 1, 0)
+        return y.reshape((T, B) + y.shape[3:])
+
+    stored_z = jax.lax.stop_gradient(stored_posterior).astype(cdt)
+    stored_h = jax.lax.stop_gradient(stored_recurrent).astype(cdt)
+    valid = (
+        jax.lax.stop_gradient(stored_valid).astype(cdt)
+        if stored_valid is not None
+        else jnp.ones((T, B, 1), cdt)
+    )
+    k_main, k_burn = jax.random.split(key)
+    boundary_rows = np.arange(1, K) * C  # first row of chunks 1..K-1 (static)
+
+    if burn_in > 0:
+        # burn-in: re-run the `burn_in` rows before each boundary from the
+        # state stored just before them; only the final carry is used, and it
+        # is gradient-stopped, so no gradient flows through the burn scan
+        burn_rows = boundary_rows[:, None] - burn_in + np.arange(burn_in)[None, :]
+
+        def gather_fold(x):  # rows [K-1, burn_in] of [T, B, ...] -> [burn_in, (K-1)*B, ...]
+            g = x[burn_rows]
+            g = jnp.moveaxis(g, 0, 1)
+            return g.reshape((burn_in, (K - 1) * B) + g.shape[3:])
+
+        init_rows = boundary_rows - burn_in - 1
+        z0 = stored_z[init_rows].reshape(((K - 1) * B, stoch_flat))
+        h0 = stored_h[init_rows].reshape(((K - 1) * B, recurrent_size))
+        bf = gather_fold(is_first)
+        invalid = 1.0 - valid[init_rows].reshape(((K - 1) * B, 1))
+        bf = bf.at[0].set(jnp.maximum(bf[0], invalid))
+        xs_burn = (
+            gather_fold(batch_actions),
+            gather_fold(embedded),
+            bf,
+            jax.random.split(k_burn, burn_in),
+        )
+        (z_fresh, h_fresh), _ = jax.lax.scan(scan_body, (z0, h0), xs_burn, unroll=unroll)
+        z_rest = jax.lax.stop_gradient(z_fresh).reshape((K - 1, B, stoch_flat))
+        h_rest = jax.lax.stop_gradient(h_fresh).reshape((K - 1, B, recurrent_size))
+        is_first_adj = is_first
+    else:
+        init_rows = boundary_rows - 1
+        z_rest = stored_z[init_rows]
+        h_rest = stored_h[init_rows]
+        # a chunk starting on a row whose predecessor was never written by
+        # the player (prefill / bookkeeping) resets like a sequence start
+        invalid = 1.0 - valid[init_rows]  # [K-1, B, 1]
+        is_first_adj = is_first.at[boundary_rows].set(
+            jnp.maximum(is_first[boundary_rows], invalid)
+        )
+
+    z_init = jnp.concatenate([jnp.zeros((1, B, stoch_flat), cdt), z_rest], axis=0)
+    h_init = jnp.concatenate([jnp.zeros((1, B, recurrent_size), cdt), h_rest], axis=0)
+    init = (z_init.reshape((K * B, stoch_flat)), h_init.reshape((K * B, recurrent_size)))
+    xs = (fold(batch_actions), fold(embedded), fold(is_first_adj), jax.random.split(k_main, C))
+    _, ys = jax.lax.scan(scan_body, init, xs, unroll=unroll)
+    return jax.tree_util.tree_map(unfold, ys)
+
+
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
     "Game/ep_len_avg",

@@ -20,7 +20,7 @@ import optax
 
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _dreamer_main
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import update_moments
+from sheeprl_tpu.algos.dreamer_v3.utils import chunked_dynamic_scan, rssm_scan_spec, update_moments
 from sheeprl_tpu.algos.dreamer_v3_jepa.agent import build_agent as _build_agent_full, encoder_subtree
 from sheeprl_tpu.algos.dreamer_v3_jepa.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER  # noqa: F401
 from sheeprl_tpu.models.jepa import jepa_loss, make_two_views
@@ -81,6 +81,10 @@ def make_train_step(
     mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
     jepa_coef = cfg.algo.jepa_coef
     ema_m = cfg.algo.jepa_ema
+    # chunked sequence-parallel RSSM scan + unroll lever (inherited from the
+    # shared DV3 config surface — see dreamer_v3.py::make_train_step)
+    scan_unroll = int(cfg.algo.get("scan_unroll", 1))
+    rssm_chunks, rssm_burn_in = rssm_scan_spec(cfg)
     projector_def = _HEADS["projector_def"]
     predictor_def = _HEADS["predictor_def"]
 
@@ -133,10 +137,21 @@ def make_train_step(
                 )
                 return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
-            keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
-            _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
-                scan_body, init, (batch_actions, embedded, is_first, keys_t)
+            recurrents, posteriors, post_logits, prior_logits = chunked_dynamic_scan(
+                scan_body,
+                batch_actions,
+                embedded,
+                is_first,
+                k_wm,
+                stoch_flat=stoch_flat,
+                recurrent_size=recurrent_size,
+                cdt=cdt,
+                chunks=rssm_chunks,
+                burn_in=rssm_burn_in,
+                stored_recurrent=batch.get("rssm_recurrent"),
+                stored_posterior=batch.get("rssm_posterior"),
+                stored_valid=batch.get("rssm_valid"),
+                unroll=scan_unroll,
             )
             latents = jnp.concatenate([posteriors, recurrents], axis=-1)
             recon = world_model_def.apply(wm_params, latents, method="decode")
@@ -240,7 +255,9 @@ def make_train_step(
                 return (prior, recurrent, actions), (latent, actions)
 
             keys_h = jax.random.split(k_img, horizon)
-            _, (latents_h, actions_h) = jax.lax.scan(img_body, (posteriors, recurrents, a0), keys_h)
+            _, (latents_h, actions_h) = jax.lax.scan(
+                img_body, (posteriors, recurrents, a0), keys_h, unroll=scan_unroll
+            )
             imagined_trajectories = jnp.concatenate([latent0[None], latents_h], axis=0)
             imagined_actions = jnp.concatenate([a0[None], actions_h], axis=0)
 

@@ -31,7 +31,13 @@ import optax
 from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3  # noqa: F401  (re-export for evaluate)
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _dreamer_main
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import init_moments_state, test, update_moments
+from sheeprl_tpu.algos.dreamer_v3.utils import (
+    chunked_dynamic_scan,
+    init_moments_state,
+    rssm_scan_spec,
+    test,
+    update_moments,
+)
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
 from sheeprl_tpu.algos.p2e_dv3.utils import (  # noqa: F401
     AGGREGATOR_KEYS,
@@ -106,6 +112,10 @@ def make_train_step(
     mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
     weights_sum = sum(w for _, w, _ in critics_spec)
     intrinsic_mult = cfg.algo.intrinsic_reward_multiplier
+    # chunked sequence-parallel RSSM scan + unroll lever (inherited from the
+    # shared DV3 config surface — see dreamer_v3.py::make_train_step)
+    scan_unroll = int(cfg.algo.get("scan_unroll", 1))
+    rssm_chunks, rssm_burn_in = rssm_scan_spec(cfg)
 
     def ensembles_apply(ens_params, x):
         return jax.vmap(lambda p: ensemble_def.apply(p, x))(ens_params)
@@ -130,7 +140,9 @@ def make_train_step(
             return (prior, recurrent, actions), (latent, actions)
 
         keys_h = jax.random.split(k_img, horizon)
-        _, (latents_h, actions_h) = jax.lax.scan(img_body, (posteriors, recurrents, a0), keys_h)
+        _, (latents_h, actions_h) = jax.lax.scan(
+            img_body, (posteriors, recurrents, a0), keys_h, unroll=scan_unroll
+        )
         trajectories = jnp.concatenate([latent0[None], latents_h], axis=0)
         actions = jnp.concatenate([a0[None], actions_h], axis=0)
         return trajectories, actions
@@ -171,10 +183,21 @@ def make_train_step(
                 )
                 return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
-            keys_t = jax.random.split(k_wm, T)
-            init = (jnp.zeros((B, stoch_flat), cdt), jnp.zeros((B, recurrent_size), cdt))
-            _, (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
-                scan_body, init, (batch_actions, embedded, is_first, keys_t)
+            recurrents, posteriors, post_logits, prior_logits = chunked_dynamic_scan(
+                scan_body,
+                batch_actions,
+                embedded,
+                is_first,
+                k_wm,
+                stoch_flat=stoch_flat,
+                recurrent_size=recurrent_size,
+                cdt=cdt,
+                chunks=rssm_chunks,
+                burn_in=rssm_burn_in,
+                stored_recurrent=batch.get("rssm_recurrent"),
+                stored_posterior=batch.get("rssm_posterior"),
+                stored_valid=batch.get("rssm_valid"),
+                unroll=scan_unroll,
             )
             latents = jnp.concatenate([posteriors, recurrents], axis=-1)
             recon = world_model_def.apply(wm_params, latents, method="decode")

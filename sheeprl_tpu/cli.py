@@ -194,6 +194,28 @@ def check_configs(cfg: dotdict) -> None:
             "diagnostics.health.detectors.entropy_floor — a drill against a disarmed "
             "detector could never fire"
         )
+    # chunked RSSM scan knobs (DV3-family): fail at compose time, not at the
+    # first train-step trace hours into a run
+    rssm_chunks = cfg.algo.get("rssm_chunks")
+    if rssm_chunks is not None:
+        rssm_chunks = int(rssm_chunks)
+        if rssm_chunks < 1:
+            raise ValueError(f"algo.rssm_chunks must be >= 1, got {rssm_chunks}")
+        burn_in = int(cfg.algo.get("rssm_chunk_burn_in", 0) or 0)
+        if burn_in < 0:
+            raise ValueError(f"algo.rssm_chunk_burn_in must be >= 0, got {burn_in}")
+        seq_len = cfg.algo.get("per_rank_sequence_length")
+        if rssm_chunks > 1 and isinstance(seq_len, int):
+            if seq_len % rssm_chunks != 0:
+                raise ValueError(
+                    f"algo.rssm_chunks ({rssm_chunks}) must divide "
+                    f"algo.per_rank_sequence_length ({seq_len})"
+                )
+            if burn_in >= seq_len // rssm_chunks:
+                raise ValueError(
+                    f"algo.rssm_chunk_burn_in ({burn_in}) must be < the chunk length "
+                    f"({seq_len // rssm_chunks} = per_rank_sequence_length / rssm_chunks)"
+                )
     learning_starts = cfg.algo.get("learning_starts")
     if learning_starts is not None and learning_starts < 0:
         raise ValueError("The `algo.learning_starts` parameter must be greater or equal to zero")

@@ -48,3 +48,28 @@ def step_slab(
             arr = arr.reshape(num_envs, 1)
         out[key] = arr[np.newaxis]
     return out
+
+
+def rssm_state_slab(num_envs: int, recurrent: Any, stochastic: Any, valid: bool) -> Dict[str, Any]:
+    """``[1, num_envs, ...]`` replay record of the player's post-step RSSM
+    state (``algo.rssm_chunks > 1`` — see
+    ``sheeprl_tpu/algos/dreamer_v3/utils.py::RSSM_STATE_KEYS``).
+
+    ``recurrent``/``stochastic`` are the ``[num_envs, H]`` / ``[num_envs, Z]``
+    state the player already computed for this step; numpy arrays pass
+    through as views, **device arrays stay on device** (the HBM-resident
+    replay path writes them without a host round trip).  ``valid=False``
+    marks rows written without a real player state (prefill random actions,
+    episode-end bookkeeping rows): a chunk whose initial state lands on such
+    a row resets to the learned initial state instead of training on
+    garbage."""
+    if recurrent.shape[0] != num_envs or stochastic.shape[0] != num_envs:
+        raise ValueError(
+            f"rssm_state_slab states must be [num_envs={num_envs}, ...], got "
+            f"{recurrent.shape} / {stochastic.shape}"
+        )
+    return {
+        "rssm_recurrent": recurrent[np.newaxis],
+        "rssm_posterior": stochastic[np.newaxis],
+        "rssm_valid": np.full((1, num_envs, 1), 1.0 if valid else 0.0, np.float32),
+    }

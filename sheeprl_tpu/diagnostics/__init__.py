@@ -438,15 +438,20 @@ class Diagnostics:
                 self.telemetry.span_exit(token)
 
     # -- telemetry hooks ---------------------------------------------------
-    def instrument(self, name: str, fn, kind: str = "train", donate_argnums=()):
+    def instrument(self, name: str, fn, kind: str = "train", donate_argnums=(), cost_note=None):
         """Wrap a jitted step for the recompile watchdog + FLOPs accounting
         (``kind="train"``) or signature-watch only (``kind="rollout"``).
         ``donate_argnums`` declares which arguments the wrapped jit donates —
         the memory monitor verifies the donation actually happened at first
-        dispatch.  Identity when telemetry is disabled."""
+        dispatch.  ``cost_note`` is a caveat journaled with the step's
+        ``telemetry_cost`` FLOPs (e.g. unrolled scans inflate
+        ``cost_analysis()``, so MFU must not be read at face value).
+        Identity when telemetry is disabled."""
         if self.telemetry is None:
             return fn
-        return self.telemetry.instrument(name, fn, kind=kind, donate_argnums=donate_argnums)
+        return self.telemetry.instrument(
+            name, fn, kind=kind, donate_argnums=donate_argnums, cost_note=cost_note
+        )
 
     def note_env_steps(self, n: int) -> None:
         """Count ``n`` env steps toward ``Telemetry/env_steps_per_sec`` and

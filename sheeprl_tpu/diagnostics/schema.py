@@ -44,6 +44,8 @@ EVENT_KINDS: Dict[str, str] = {
     "telemetry_fallback": "AOT compile/dispatch failed; the step reverted to native jit dispatch",
     "metrics_server": "the /metrics endpoint address (or its bind failure)",
     "compilation_cache": "JAX on-disk compilation cache enabled (directory recorded)",
+    "aot_cache_hit": "persistent AOT executable cache: a serialized executable was loaded instead of compiling (fn, entry path, FLOPs)",
+    "aot_cache_miss": "persistent AOT executable cache: no usable entry — reason absent/corrupt/fingerprint_mismatch/store_failed — so a fresh compile ran",
     "telemetry_summary": "closing perf totals (recompiles, compile time, FLOPs, phase seconds)",
     "memory_breakdown": "one-shot static footprint decomposition at first train dispatch",
     "sharding_audit": "per-leaf bytes/sharding table of the first train dispatch",

@@ -27,6 +27,23 @@ inside the run itself, instead of from offline ``bench.py`` snapshots
   peak table (or ``telemetry.mfu.peak_tflops_per_device``) —
   ``Telemetry/mfu``; the policy-step counter gives ``Telemetry/sps``.
 
+* **Persistent AOT executable cache** — with
+  ``diagnostics.compilation_cache_dir`` set, every executable the AOT path
+  compiles is also serialized to disk
+  (``jax.experimental.serialize_executable``) keyed by (fn name, dispatch
+  signature, config hash) and stamped with a jax/jaxlib/platform
+  fingerprint.  A restarted process loads the executable instead of
+  recompiling — production restarts and recompile storms cost seconds, not
+  minutes — journaling ``aot_cache_hit`` per loaded signature;
+  ``aot_cache_miss`` records why a fresh compile ran (``absent`` /
+  ``corrupt`` / ``fingerprint_mismatch`` / ``store_failed``), and a corrupt
+  or stale entry always falls back to a fresh compile that overwrites it.
+  This complements JAX's own on-disk compilation cache (enabled from the
+  same directory at CLI startup): that one caches *compilation*, this one
+  caches the loaded executable, skipping even the lowering/cache-probe work
+  on the hot restart path and surviving backends where the XLA cache is
+  unavailable.
+
 * **Phase attribution** — the facade's existing ``span`` hooks (rollout /
   env_step_async / env_wait / buffer-sample / train / checkpoint) feed a
   nesting-aware self-time accumulator (a child span's time is subtracted from
@@ -41,6 +58,7 @@ inherits live perf telemetry without loop changes.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import warnings
@@ -213,6 +231,7 @@ class _Instrumented:
         fn: Callable,
         kind: str,
         donate_argnums: Tuple[int, ...] = (),
+        cost_note: Optional[str] = None,
     ):
         self._telemetry = telemetry
         self._fn = fn
@@ -221,6 +240,10 @@ class _Instrumented:
         # what the call site DECLARED it donates — the memory monitor verifies
         # the buffers were actually consumed at first dispatch
         self.donate_argnums = tuple(donate_argnums or ())
+        # caller-supplied caveat on the cost_analysis FLOPs (e.g. unrolled
+        # scans inflate them) — journaled with every telemetry_cost event so
+        # MFU is never silently over-reported on such graphs
+        self.cost_note = cost_note
         self._use_aot = kind == "train" and telemetry.cost_analysis_enabled
         self._signature: Optional[Tuple[str, Tuple]] = None
         self._seen: set = set()
@@ -301,26 +324,68 @@ class _Instrumented:
             return fn(*args, **kwargs)
         return mem.guarded_call(self, lambda: fn(*args, **kwargs), args, kwargs, count_call=not retry)
 
+    def _fresh_compile(self, args, kwargs):
+        """The one place a new executable is built — the warm-restart tests
+        monkeypatch/count this to prove a cached restart compiles nothing."""
+        return self._fn.lower(*args, **kwargs).compile()
+
     def _aot_compile(self, sig, args, kwargs):
         tele = self._telemetry
+        cache_path = fingerprint = None
+        if tele.aot_cache_dir:
+            cache_path = aot_cache_path(tele.aot_cache_dir, self.name, sig, tele._aot_cache_salt)
+            fingerprint = aot_cache_fingerprint()
+            hit, miss_reason = _aot_cache_read(cache_path, fingerprint)
+            if hit is not None:
+                compiled, flops = hit
+                if flops:
+                    self._flops_by_sig[sig] = flops
+                self._compiled[sig] = compiled
+                if tele._memory is not None:
+                    tele._memory.note_executable(self.name, compiled)
+                hit_fields = dict(fn=self.name, path=cache_path, flops_per_call=flops)
+                if self.cost_note:
+                    # the warm restart never journals a telemetry_cost event,
+                    # so the FLOPs-inflation caveat must ride the hit itself —
+                    # the loaded FLOPs feed Telemetry/mfu exactly like fresh
+                    # ones would
+                    hit_fields["note"] = self.cost_note
+                tele._journal("aot_cache_hit", **hit_fields)
+                return compiled
+            tele._journal(
+                "aot_cache_miss", fn=self.name, stage="load", reason=miss_reason, path=cache_path
+            )
         try:
             t0 = time.perf_counter()
-            compiled = self._fn.lower(*args, **kwargs).compile()
+            compiled = self._fresh_compile(args, kwargs)
             compile_s = time.perf_counter() - t0
             flops = _cost_flops(compiled)
             if flops:
                 self._flops_by_sig[sig] = flops
-                tele._journal(
-                    "telemetry_cost",
-                    fn=self.name,
-                    flops_per_call=flops,
-                    compile_s=round(compile_s, 3),
+                cost_fields = dict(
+                    fn=self.name, flops_per_call=flops, compile_s=round(compile_s, 3)
                 )
+                if self.cost_note:
+                    cost_fields["note"] = self.cost_note
+                tele._journal("telemetry_cost", **cost_fields)
             self._compiled[sig] = compiled
             if tele._memory is not None:
                 # the executable's memory_analysis (activation temps etc.)
                 # feeds the memory_breakdown event — zero extra compiles
                 tele._memory.note_executable(self.name, compiled)
+            if cache_path is not None:
+                store_err = _aot_cache_write(cache_path, fingerprint, compiled, flops)
+                if store_err is not None:
+                    # backends without executable serialization: the run is
+                    # unaffected, but the next restart will compile again —
+                    # journal it so "why was the restart cold?" has an answer
+                    tele._journal(
+                        "aot_cache_miss",
+                        fn=self.name,
+                        stage="store",
+                        reason=f"store_failed: {store_err}",
+                        path=cache_path,
+                    )
             return compiled
         except Exception as err:
             self._use_aot = False
@@ -347,6 +412,145 @@ def _cost_flops(compiled: Any) -> Optional[float]:
         return float(cost.get("flops", 0.0)) or None
     except Exception:
         return None
+
+
+# ---------------------------------------------------------------------------
+# persistent AOT executable cache (diagnostics.compilation_cache_dir)
+
+#: Bumped when the on-disk entry layout changes; part of the fingerprint so
+#: old entries invalidate cleanly instead of failing to unpickle.
+AOT_CACHE_FORMAT = 1
+
+
+def _code_fingerprint() -> str:
+    """Version component of the cache fingerprint: package version plus — in
+    a git checkout — the HEAD revision (read from ``.git`` directly, no
+    subprocess).  The executable cache skips lowering entirely, so unlike
+    JAX's own compilation cache it can never notice a source edit via the
+    HLO hash; this component invalidates on version bumps and commits
+    instead.  (Uncommitted source edits in a dev checkout still hit stale
+    entries — clear the cache dir when iterating on graph code.)"""
+    try:
+        import sheeprl_tpu
+
+        version = str(getattr(sheeprl_tpu, "__version__", "?"))
+        root = os.path.dirname(os.path.dirname(os.path.abspath(sheeprl_tpu.__file__)))
+        head_path = os.path.join(root, ".git", "HEAD")
+        rev = ""
+        if os.path.exists(head_path):
+            with open(head_path) as fh:
+                head = fh.read().strip()
+            if head.startswith("ref:"):
+                ref_path = os.path.join(root, ".git", *head.split(" ", 1)[1].split("/"))
+                if os.path.exists(ref_path):
+                    with open(ref_path) as fh:
+                        rev = fh.read().strip()[:12]
+            else:
+                rev = head[:12]
+        return f"{version}@{rev}" if rev else version
+    except Exception:  # pragma: no cover - never block the cache on this
+        return "?"
+
+
+def aot_cache_fingerprint() -> str:
+    """Environment stamp an executable is only valid under: code version
+    (package version + git HEAD when available), jax + jaxlib versions,
+    backend platform, device kind and device count (a serialized executable
+    is compiled FOR a specific code revision, runtime and topology)."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover - jaxlib always ships with jax
+        jaxlib_version = "?"
+    try:
+        devices = jax.devices()
+        kind = devices[0].device_kind if devices else ""
+        count = len(devices)
+    except Exception:  # pragma: no cover - pre-init probes
+        kind, count = "", 0
+    return "|".join(
+        [
+            f"fmt{AOT_CACHE_FORMAT}",
+            _code_fingerprint(),
+            jax.__version__,
+            str(jaxlib_version),
+            jax.default_backend(),
+            str(kind),
+            str(count),
+        ]
+    )
+
+
+def aot_cache_path(cache_dir: str, name: str, sig: Tuple[str, Tuple], salt: str) -> str:
+    """Entry file for one (fn, dispatch signature, config) triple.  The
+    fingerprint is deliberately NOT part of the key: a jax upgrade then reads
+    the old entry and journals ``fingerprint_mismatch`` (observable
+    invalidation) instead of silently orphaning files."""
+    import hashlib
+
+    digest = hashlib.sha256(repr((name, sig, salt)).encode()).hexdigest()[:32]
+    safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in name)[:48]
+    return os.path.join(str(cache_dir), f"{safe}-{digest}.aotx")
+
+
+def _aot_cache_read(path: str, fingerprint: str):
+    """(compiled, flops) from one cache entry, or (None, reason) on any miss.
+    Every failure mode — missing file, truncated/corrupt pickle, wrong
+    fingerprint, deserialize rejection — is a *reason string*, never an
+    exception: the caller always has the fresh-compile fallback."""
+    import pickle
+
+    if not os.path.exists(path):
+        return None, "absent"
+    try:
+        with open(path, "rb") as fh:
+            entry = pickle.load(fh)
+        if not isinstance(entry, dict):
+            return None, "corrupt"
+    except Exception:
+        return None, "corrupt"
+    if entry.get("fingerprint") != fingerprint:
+        return None, "fingerprint_mismatch"
+    try:
+        from jax.experimental import serialize_executable
+
+        compiled = serialize_executable.deserialize_and_load(
+            entry["payload"], entry["in_tree"], entry["out_tree"]
+        )
+        return (compiled, entry.get("flops")), None
+    except Exception:
+        return None, "corrupt"
+
+
+def _aot_cache_write(path: str, fingerprint: str, compiled: Any, flops: Optional[float]) -> Optional[str]:
+    """Serialize ``compiled`` to ``path`` (atomic tmp+rename so a crashed
+    writer can only ever leave a *missing* entry, not a half one).  Returns an
+    error string on failure (backends without executable serialization),
+    None on success."""
+    import pickle
+
+    try:
+        from jax.experimental import serialize_executable
+
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        entry = {
+            "fingerprint": fingerprint,
+            "flops": flops,
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump(entry, fh)
+        os.replace(tmp, path)
+        return None
+    except Exception as err:
+        return repr(err)[:200]
 
 
 # ---------------------------------------------------------------------------
@@ -382,6 +586,47 @@ class Telemetry:
         self.http_enabled = bool(http_cfg.get("enabled", False))
         self.http_host = str(http_cfg.get("host", "127.0.0.1"))
         self.http_port = int(http_cfg.get("port", 0))
+        # persistent AOT executable cache: same directory as JAX's on-disk
+        # compilation cache (diagnostics.compilation_cache_dir — both are
+        # restart accelerators and both are off when it is null).  The salt
+        # folds the config identity into every cache key: two runs with
+        # identical dispatch signatures but different graphs (e.g.
+        # scan_unroll / rssm_chunks flips) must never share an executable.
+        self.aot_cache_dir = str(diag_cfg.get("compilation_cache_dir") or "") or None
+        self._aot_cache_salt = ""
+        if self.aot_cache_dir:
+            try:
+                from sheeprl_tpu.diagnostics import config_hash
+                from sheeprl_tpu.utils.utils import dotdict
+
+                # hash only the GRAPH-shaping config sections: restarts and
+                # resumes legitimately differ in run identity (run_name,
+                # checkpoint.resume_from, seed, logging) and must still hit;
+                # anything that changes the compiled graph without changing
+                # the dispatch signature (scan_unroll, rssm_chunk_burn_in,
+                # horizon, sentinel/health toggles, precision) must MISS.
+                # Sections are deep-converted to plain dicts first: the CLI
+                # hands dotdict sections, which yaml.safe_dump rejects.
+                graph_cfg = {}
+                for k in ("algo", "env", "fabric", "distribution", "diagnostics", "buffer"):
+                    v = (cfg or {}).get(k)
+                    if v is None:
+                        continue
+                    graph_cfg[k] = dotdict(v).as_dict() if isinstance(v, dict) else v
+                self._aot_cache_salt = config_hash(graph_cfg)
+            except Exception as err:
+                # an un-hashable config must DISABLE the cache, not fall back
+                # to an empty salt: an empty salt would let two different
+                # graphs with identical dispatch signatures share an
+                # executable
+                self.aot_cache_dir = None
+                warnings.warn(
+                    "diagnostics.compilation_cache_dir is set but the config could not "
+                    f"be hashed for the AOT executable cache key ({err!r}); the "
+                    "executable cache is DISABLED for this run (JAX's own on-disk "
+                    "compilation cache is unaffected).",
+                    RuntimeWarning,
+                )
 
         self._precision = str((cfg.get("fabric") or {}).get("precision", "32-true")) if cfg else "32-true"
         self._clock = clock
@@ -463,11 +708,18 @@ class Telemetry:
 
     # -- instrumentation ---------------------------------------------------
     def instrument(
-        self, name: str, fn: Callable, kind: str = "train", donate_argnums: Tuple[int, ...] = ()
+        self,
+        name: str,
+        fn: Callable,
+        kind: str = "train",
+        donate_argnums: Tuple[int, ...] = (),
+        cost_note: Optional[str] = None,
     ) -> Callable:
         if not self.enabled:
             return fn
-        wrapped = _Instrumented(self, name, fn, kind, donate_argnums=donate_argnums)
+        wrapped = _Instrumented(
+            self, name, fn, kind, donate_argnums=donate_argnums, cost_note=cost_note
+        )
         self._instrumented[name] = wrapped
         return wrapped
 

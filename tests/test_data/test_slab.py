@@ -218,3 +218,57 @@ def test_device_buffer_slab_add_equals_indexed_adds():
     np.testing.assert_array_equal(sw["pos"], sp["pos"])
     for k in sw["buffer"]:
         np.testing.assert_array_equal(sw["buffer"][k], sp["buffer"][k], err_msg=k)
+
+
+def test_rssm_state_slab_layout_valid_flag_and_passthrough():
+    """rssm_state_slab builds the [1, N, ...] chunked-scan state record:
+    numpy in -> numpy views out, device arrays stay device arrays (the HBM
+    replay path writes them without a host round trip), the valid flag is a
+    float32 column, and a per-env shaped input raises."""
+    from sheeprl_tpu.data.slab import rssm_state_slab
+
+    n, h, z = 3, 5, 4
+    rec = np.arange(n * h, dtype=np.float32).reshape(n, h)
+    sto = np.arange(n * z, dtype=np.float32).reshape(n, z)
+    slab = rssm_state_slab(n, rec, sto, valid=True)
+    assert set(slab) == {"rssm_recurrent", "rssm_posterior", "rssm_valid"}
+    assert slab["rssm_recurrent"].shape == (1, n, h)
+    assert slab["rssm_posterior"].shape == (1, n, z)
+    np.testing.assert_array_equal(slab["rssm_valid"], np.ones((1, n, 1), np.float32))
+    np.testing.assert_array_equal(slab["rssm_recurrent"][0], rec)
+
+    invalid = rssm_state_slab(n, rec, sto, valid=False)
+    np.testing.assert_array_equal(invalid["rssm_valid"], np.zeros((1, n, 1), np.float32))
+
+    import jax.numpy as jnp
+
+    dev = rssm_state_slab(n, jnp.asarray(rec), jnp.asarray(sto), valid=True)
+    assert isinstance(dev["rssm_recurrent"], jnp.ndarray)  # stayed on device
+
+    with pytest.raises(ValueError, match="num_envs"):
+        rssm_state_slab(n + 1, rec, sto, valid=True)
+
+
+def test_rssm_state_keys_survive_sequential_sample():
+    """The stored-state keys ride the buffer like any other key: added per
+    step, returned by the sequence sample with the right per-row values —
+    the chunked train step slices chunk inits out of exactly this."""
+    from sheeprl_tpu.data.buffers import SequentialReplayBuffer
+    from sheeprl_tpu.data.slab import rssm_state_slab
+
+    n, h, z, steps = 2, 4, 3, 6
+    rb = SequentialReplayBuffer(8, n_envs=n)
+    for t in range(steps):
+        rec = np.full((n, h), float(t), np.float32)
+        sto = np.full((n, z), float(t) + 0.5, np.float32)
+        data = step_slab(
+            n,
+            {"state": np.zeros((n, 3), np.float32), "rewards": np.zeros((n,), np.float32)},
+        )
+        data.update(rssm_state_slab(n, rec, sto, valid=(t >= 2)))
+        rb.add(data)
+    out = rb.sample(1, sequence_length=steps, n_samples=1)
+    seq_rec = out["rssm_recurrent"][0, :, 0]  # [T, h]
+    seq_valid = out["rssm_valid"][0, :, 0, 0]
+    np.testing.assert_array_equal(seq_rec[:, 0], np.arange(steps, dtype=np.float32))
+    np.testing.assert_array_equal(seq_valid, np.array([0, 0, 1, 1, 1, 1], np.float32))

@@ -1,0 +1,253 @@
+"""Persistent AOT executable cache (ROADMAP item 2: restarts cost seconds).
+
+With ``diagnostics.compilation_cache_dir`` set, the telemetry AOT path
+serializes every compiled train executable
+(``jax.experimental.serialize_executable``) and a restarted process loads it
+instead of recompiling.  The contract:
+
+* **warm restart**: a second process (modeled as a second ``Diagnostics``
+  instance — the cache is keyed by fn/signature/config, not by process)
+  performs ZERO fresh ``lower().compile()`` calls for previously seen
+  signatures, journals ``aot_cache_hit``, returns identical values, and
+  still captures the FLOPs MFU needs;
+* **corrupt entry**: a truncated/garbage cache file falls back to a fresh
+  compile with a journaled ``aot_cache_miss`` reason=corrupt, and the
+  rewritten entry hits on the next load;
+* **fingerprint mismatch**: an entry stamped by a different jax/jaxlib/
+  platform invalidates cleanly (journaled reason, fresh compile, entry
+  replaced under the current fingerprint);
+* **config salt**: two configs with different graph-shaping sections never
+  share a cache entry even at identical dispatch signatures.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu.diagnostics.telemetry as telemetry_mod
+from sheeprl_tpu.diagnostics import build_diagnostics, read_journal
+from sheeprl_tpu.diagnostics.telemetry import (
+    Telemetry,
+    aot_cache_fingerprint,
+    aot_cache_path,
+)
+
+
+def _cfg(cache_dir, **algo_extra):
+    return {
+        "diagnostics": {
+            "enabled": True,
+            "journal": {"enabled": True},
+            "sentinel": {"enabled": False},
+            "trace": {"enabled": False},
+            "compilation_cache_dir": str(cache_dir),
+            "telemetry": {"enabled": True},
+        },
+        "fabric": {"precision": "32-true"},
+        "algo": {"name": "ppo", **algo_extra},
+        "env": {"id": "discrete_dummy"},
+        "seed": 0,
+    }
+
+
+@pytest.fixture()
+def compile_counter(monkeypatch):
+    """Counts every fresh ``lower().compile()`` the AOT path performs — the
+    zero-fresh-compiles acceptance is asserted on this, not on wall-clock."""
+    calls = {"n": 0}
+    orig = telemetry_mod._Instrumented._fresh_compile
+
+    def counting(self, args, kwargs):
+        calls["n"] += 1
+        return orig(self, args, kwargs)
+
+    monkeypatch.setattr(telemetry_mod._Instrumented, "_fresh_compile", counting)
+    return calls
+
+
+def _train_fn():
+    return jax.jit(lambda x: (x @ x.T).sum())
+
+
+def _dispatch_once(cfg, log_dir, x):
+    diag = build_diagnostics(cfg).open(str(log_dir))
+    step = diag.instrument("train_step", _train_fn(), kind="train")
+    out = np.asarray(step(x))
+    diag.close()
+    return out, read_journal(os.path.join(str(log_dir), "journal.jsonl"))
+
+
+def _events(journal, kind):
+    return [e for e in journal if e["event"] == kind]
+
+
+def test_warm_restart_zero_fresh_compiles(tmp_path, compile_counter):
+    cache = tmp_path / "cache"
+    x = jnp.arange(16.0).reshape(4, 4)
+
+    cold, j_cold = _dispatch_once(_cfg(cache), tmp_path / "run1", x)
+    assert compile_counter["n"] == 1
+    (miss,) = _events(j_cold, "aot_cache_miss")
+    assert miss["reason"] == "absent" and miss["stage"] == "load"
+    assert not _events(j_cold, "aot_cache_hit")
+    assert any(f.endswith(".aotx") for f in os.listdir(cache))
+
+    compile_counter["n"] = 0
+    warm, j_warm = _dispatch_once(_cfg(cache), tmp_path / "run2", x)
+    assert compile_counter["n"] == 0, "warm restart performed a fresh compile"
+    (hit,) = _events(j_warm, "aot_cache_hit")
+    assert hit["fn"] == "train_step" and hit["flops_per_call"] > 0
+    assert not _events(j_warm, "aot_cache_miss")
+    assert warm == cold
+    # the FLOPs ride the cache entry, so MFU accounting works without a
+    # single compile in the warm process
+    summary = next(e for e in j_warm if e["event"] == "telemetry_summary")
+    assert summary["train_flops_total"] > 0
+
+
+def test_corrupt_entry_falls_back_and_heals(tmp_path, compile_counter):
+    cache = tmp_path / "cache"
+    x = jnp.arange(16.0).reshape(4, 4)
+    _dispatch_once(_cfg(cache), tmp_path / "run1", x)
+
+    (entry,) = [f for f in os.listdir(cache) if f.endswith(".aotx")]
+    (cache / entry).write_bytes(b"\x00garbage-not-a-pickle")
+
+    compile_counter["n"] = 0
+    out, journal = _dispatch_once(_cfg(cache), tmp_path / "run2", x)
+    assert compile_counter["n"] == 1  # fell back to a fresh compile
+    (miss,) = [e for e in _events(journal, "aot_cache_miss") if e["stage"] == "load"]
+    assert miss["reason"] == "corrupt"
+    assert float(out) == float(np.asarray(_train_fn()(x)))
+
+    # the fresh compile overwrote the corrupt entry: next load hits
+    compile_counter["n"] = 0
+    _, j3 = _dispatch_once(_cfg(cache), tmp_path / "run3", x)
+    assert compile_counter["n"] == 0
+    assert _events(j3, "aot_cache_hit")
+
+
+def test_truncated_entry_is_a_corrupt_miss(tmp_path, compile_counter):
+    cache = tmp_path / "cache"
+    x = jnp.arange(16.0).reshape(4, 4)
+    _dispatch_once(_cfg(cache), tmp_path / "run1", x)
+    (entry,) = [f for f in os.listdir(cache) if f.endswith(".aotx")]
+    raw = (cache / entry).read_bytes()
+    (cache / entry).write_bytes(raw[: len(raw) // 2])  # SIGKILL-mid-write shape
+
+    compile_counter["n"] = 0
+    _, journal = _dispatch_once(_cfg(cache), tmp_path / "run2", x)
+    assert compile_counter["n"] == 1
+    (miss,) = [e for e in _events(journal, "aot_cache_miss") if e["stage"] == "load"]
+    assert miss["reason"] == "corrupt"
+
+
+def test_fingerprint_mismatch_invalidates_cleanly(tmp_path, compile_counter):
+    cache = tmp_path / "cache"
+    x = jnp.arange(16.0).reshape(4, 4)
+    _dispatch_once(_cfg(cache), tmp_path / "run1", x)
+
+    (entry,) = [f for f in os.listdir(cache) if f.endswith(".aotx")]
+    with open(cache / entry, "rb") as fh:
+        payload = pickle.load(fh)
+    payload["fingerprint"] = "fmt1|0.0.1|0.0.1|tpu|TPU v9|8"  # a different runtime
+    with open(cache / entry, "wb") as fh:
+        pickle.dump(payload, fh)
+
+    compile_counter["n"] = 0
+    _, journal = _dispatch_once(_cfg(cache), tmp_path / "run2", x)
+    assert compile_counter["n"] == 1
+    (miss,) = [e for e in _events(journal, "aot_cache_miss") if e["stage"] == "load"]
+    assert miss["reason"] == "fingerprint_mismatch"
+
+    # the rewrite stamped the CURRENT fingerprint: the next restart hits
+    compile_counter["n"] = 0
+    _, j3 = _dispatch_once(_cfg(cache), tmp_path / "run3", x)
+    assert compile_counter["n"] == 0
+    assert _events(j3, "aot_cache_hit")
+
+
+def test_graph_shaping_config_changes_the_cache_key(tmp_path):
+    """Same fn name + same dispatch signature + different graph-shaping
+    config (e.g. a scan_unroll flip) must resolve to DIFFERENT entries — the
+    salt is what makes sharing an executable across different graphs
+    impossible."""
+    t1 = Telemetry(_cfg(tmp_path / "cache"))
+    t2 = Telemetry(_cfg(tmp_path / "cache", scan_unroll=8))
+    assert t1._aot_cache_salt and t2._aot_cache_salt
+    assert t1._aot_cache_salt != t2._aot_cache_salt
+    sig = ("treedef", (((4, 4), "float32", False),))
+    p1 = aot_cache_path(str(tmp_path / "cache"), "train_step", sig, t1._aot_cache_salt)
+    p2 = aot_cache_path(str(tmp_path / "cache"), "train_step", sig, t2._aot_cache_salt)
+    assert p1 != p2
+    # run identity (seed/run_name/checkpoint) must NOT change the key —
+    # that is the restart/resume hit path
+    cfg3 = _cfg(tmp_path / "cache")
+    cfg3["seed"] = 1234
+    cfg3["run_name"] = "something_else"
+    cfg3["checkpoint"] = {"resume_from": "/some/ckpt"}
+    t3 = Telemetry(cfg3)
+    assert t3._aot_cache_salt == t1._aot_cache_salt
+
+
+def test_fingerprint_names_the_runtime_and_code_version():
+    fp = aot_cache_fingerprint()
+    assert fp.startswith(f"fmt{telemetry_mod.AOT_CACHE_FORMAT}|")
+    assert jax.__version__ in fp
+    assert jax.default_backend() in fp
+    # the code-version component (package version [+ git HEAD]): without it,
+    # editing graph code and warm-restarting would silently load the stale
+    # pre-edit executable (this layer never lowers, so no HLO hash saves it)
+    import sheeprl_tpu
+
+    assert sheeprl_tpu.__version__ in fp
+
+
+def test_salt_survives_dotdict_config_sections(tmp_path):
+    """The real CLI hands ``dotdict`` config sections (yaml.safe_dump rejects
+    dict subclasses): the salt must still be computed — an empty salt would
+    let different graphs share an executable — and must equal the plain-dict
+    spelling so in-process and CLI runs share entries."""
+    from sheeprl_tpu.utils.utils import dotdict
+
+    plain = Telemetry(_cfg(tmp_path / "cache"))
+    dotted = Telemetry(dotdict(_cfg(tmp_path / "cache")))
+    assert dotted._aot_cache_salt, "dotdict config produced an empty cache salt"
+    assert dotted._aot_cache_salt == plain._aot_cache_salt
+    assert dotted.aot_cache_dir is not None
+
+
+def test_unhashable_config_disables_the_cache_loudly(tmp_path):
+    cfg = _cfg(tmp_path / "cache")
+    cfg["algo"]["unhashable"] = object()  # yaml cannot represent this
+    with pytest.warns(RuntimeWarning, match="executable cache is DISABLED"):
+        t = Telemetry(cfg)
+    assert t.aot_cache_dir is None  # never runs with an empty salt
+
+
+def test_warm_hit_carries_the_cost_note_caveat(tmp_path, compile_counter):
+    """A warm restart never journals telemetry_cost, so the FLOPs-inflation
+    caveat (unrolled scans) must ride the aot_cache_hit event itself."""
+    note = "cost_analysis FLOPs inflate under scan unrolling (scan_unroll=8); compare step_ms, not MFU"
+    x = jnp.arange(16.0).reshape(4, 4)
+
+    def dispatch(log_dir):
+        diag = build_diagnostics(_cfg(tmp_path / "cache")).open(str(log_dir))
+        step = diag.instrument("train_step", _train_fn(), kind="train", cost_note=note)
+        step(x)
+        diag.close()
+        return read_journal(os.path.join(str(log_dir), "journal.jsonl"))
+
+    j_cold = dispatch(tmp_path / "run1")
+    (cost,) = _events(j_cold, "telemetry_cost")
+    assert cost["note"] == note
+    j_warm = dispatch(tmp_path / "run2")
+    (hit,) = _events(j_warm, "aot_cache_hit")
+    assert hit["note"] == note
+    assert not _events(j_warm, "telemetry_cost")

@@ -113,6 +113,26 @@ def test_instrumented_train_step_captures_cost_and_stays_correct(tmp_path):
     events = read_journal(str(tmp_path / "journal.jsonl"))
     (cost,) = [e for e in events if e["event"] == "telemetry_cost"]
     assert cost["fn"] == "train_step" and cost["flops_per_call"] > 0
+    assert "note" not in cost  # no caveat unless the caller declares one
+
+
+def test_cost_note_caveat_rides_the_telemetry_cost_event(tmp_path):
+    """Callers with inflated cost_analysis FLOPs (unrolled scans — PERF.md §4)
+    declare it via instrument(cost_note=...); the caveat must land on the
+    journaled telemetry_cost event so MFU is never silently over-read."""
+    import jax
+    import jax.numpy as jnp
+
+    diag = build_diagnostics(_diag_cfg()).open(str(tmp_path))
+    note = "cost_analysis FLOPs inflate under scan unrolling (scan_unroll=8); compare step_ms, not MFU"
+    step = diag.instrument(
+        "train_step", jax.jit(lambda x: (x @ x.T).sum()), kind="train", cost_note=note
+    )
+    step(jnp.arange(16.0).reshape(4, 4))
+    diag.close()
+    events = read_journal(str(tmp_path / "journal.jsonl"))
+    (cost,) = [e for e in events if e["event"] == "telemetry_cost"]
+    assert cost["note"] == note
 
 
 # ---------------------------------------------------------------------------
