@@ -851,6 +851,156 @@ def measure_learn_health(total_steps: int = 96, timeout_s: float = 240.0):
     }
 
 
+def measure_recovery(
+    state_mb: float = 32.0,
+    interval_iters: int = 12,
+    train_tick_s: float = 0.01,
+    kill_drill: bool = True,
+    drill_timeout_s: float = 420.0,
+):
+    """Resilience block (ISSUE 13), always-lands: checkpoint cost on vs off
+    the critical path, and measured time-to-recover from one injected kill.
+
+    * ``blocking_write_ms`` vs ``async_critical_path_ms`` — one ~``state_mb``
+      synthetic state saved synchronously (serialize+fsync on the caller)
+      vs submitted to the :class:`AsyncCheckpointWriter` (the caller pays
+      only the host snapshot + enqueue);
+    * ``interval_goodput`` — a simulated checkpointing interval
+      (``interval_iters`` train ticks of ``train_tick_s``, one checkpoint
+      every 4 ticks): productive share of wall-clock with blocking saves vs
+      the async writer overlapping them — the mechanism behind the
+      acceptance claim that async checkpointing raises train-span goodput;
+    * ``kill_drill`` — a tiny supervised ppo CLI run (CPU subprocess) whose
+      first child is SIGKILLed by ``tools/supervise.py
+      --kill-after-first-checkpoint`` the moment a verified checkpoint
+      exists, auto-restarted, and resumed to completion; time-to-recover and
+      the segment labels come from ``tools/goodput_report.py``'s own
+      analysis of the run's journals.
+    """
+    import subprocess
+    import sys
+    import tempfile
+    from pathlib import Path
+
+    from sheeprl_tpu.resilience.async_writer import AsyncCheckpointWriter
+    from sheeprl_tpu.resilience.manifest import save_verified_checkpoint
+
+    import numpy as np
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    n = max(1, int(state_mb * (1 << 20) / 4))
+    rng = np.random.default_rng(0)
+    state = {"params": {"w": rng.standard_normal(n).astype(np.float32)}, "policy_step": 1}
+    out: dict = {"state_bytes": n * 4}
+    with tempfile.TemporaryDirectory() as td:
+        t0 = time.perf_counter()
+        save_verified_checkpoint(os.path.join(td, "ckpt_1_0.ckpt"), state)
+        out["blocking_write_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        writer = AsyncCheckpointWriter()
+        t0 = time.perf_counter()
+        crit_s = writer.submit(os.path.join(td, "ckpt_2_0.ckpt"), state, step=2)
+        out["async_critical_path_ms"] = round(crit_s * 1e3, 3)
+        writer.drain()
+        writer.close()
+        out["async_write_ms"] = writer.stats()["last_write_ms"]
+        if out["async_critical_path_ms"] > 0:
+            out["critical_path_speedup"] = round(
+                out["blocking_write_ms"] / out["async_critical_path_ms"], 2
+            )
+
+        def interval_goodput(use_async: bool) -> float:
+            ckpt_dir = os.path.join(td, "async" if use_async else "blocking")
+            interval_writer = AsyncCheckpointWriter() if use_async else None
+            wall0 = time.perf_counter()
+            train_s = 0.0
+            for i in range(int(interval_iters)):
+                t = time.perf_counter()
+                time.sleep(train_tick_s)  # stands in for the train span
+                train_s += time.perf_counter() - t
+                if i % 4 == 3:
+                    path = os.path.join(ckpt_dir, f"ckpt_{i}_0.ckpt")
+                    if interval_writer is not None:
+                        interval_writer.submit(path, state, step=i)
+                    else:
+                        save_verified_checkpoint(path, state, step=i)
+            wall = time.perf_counter() - wall0
+            if interval_writer is not None:
+                interval_writer.close()  # writes finish off the measured window
+            return round(train_s / wall, 4) if wall > 0 else 0.0
+
+        out["interval_goodput"] = {
+            "blocking": interval_goodput(False),
+            "async": interval_goodput(True),
+        }
+
+    if not kill_drill:
+        return out
+    overrides = [
+        "exp=ppo",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "metric.log_level=1",
+        "metric.log_every=1",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=1",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.run_test=False",
+        "run_name=bench_recovery",
+        "algo.total_steps=512",
+        "checkpoint.every=16",
+        "checkpoint.save_last=False",
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo_root, "tools", "supervise.py"),
+                "--max-restarts",
+                "2",
+                "--backoff",
+                "0.5",
+                "--kill-after-first-checkpoint",
+                *overrides,
+            ],
+            cwd=td,
+            env=env,
+            timeout=drill_timeout_s,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        run_dir = Path(td) / "logs" / "runs" / "ppo" / "discrete_dummy" / "bench_recovery"
+        sys.path.insert(0, os.path.join(repo_root, "tools"))
+        try:
+            from goodput_report import analyze_segments, read_supervisor
+
+            from sheeprl_tpu.diagnostics.journal import collect_journals
+
+            journals = collect_journals([str(run_dir)])
+            analysis = analyze_segments(journals)
+            supervisor = read_supervisor(str(run_dir))
+        finally:
+            sys.path.pop(0)
+        out["kill_drill"] = {
+            "supervise_rc": proc.returncode,
+            "segments": [s["label"] for s in analysis["segments"]],
+            "time_to_recover_s": analysis["time_to_recover_s"],
+            "recovered_train_s": analysis["recovered_train_s"],
+            "restarts": (supervisor or {}).get("restarts"),
+            "measured_down_s": (supervisor or {}).get("measured_down_s"),
+        }
+    return out
+
+
 def measure_serving(
     loads=(1, 4, 16),
     duration_s: float = 3.0,
@@ -1158,6 +1308,13 @@ def _run_cpu_fallback(record: dict, precision: str) -> None:
         record["serving"] = measure_serving(loads=(2,), duration_s=1.5, buckets=(2, 4))
     except Exception as err:  # noqa: BLE001
         record.setdefault("stage_errors", {})["serving"] = repr(err)
+    # recovery block (ISSUE 13): async-vs-blocking checkpoint cost + one
+    # supervised injected-kill cycle, both CPU-native — lands on the
+    # fallback path by design (the acceptance numbers are CPU numbers)
+    try:
+        record["recovery"] = measure_recovery(state_mb=8.0)
+    except Exception as err:  # noqa: BLE001
+        record.setdefault("stage_errors", {})["recovery"] = repr(err)
 
 
 def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
@@ -1276,6 +1433,13 @@ def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
     if serving:
         record["serving"] = serving
 
+    # recovery block (ISSUE 13): checkpoint write ms off- vs on-critical-path
+    # and measured time-to-recover from one injected kill — the drill runs a
+    # CPU subprocess by design, so chip rounds carry the same numbers
+    recovery = stage("recovery", 240, measure_recovery)
+    if recovery:
+        record["recovery"] = recovery
+
 
 def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
@@ -1313,6 +1477,11 @@ def main() -> None:
         # path (measure_serving; the CPU fallback runs the smallest load).
         # Null when the stage was skipped or failed.
         "serving": None,
+        # resilience (ISSUE 13): blocking vs async checkpoint write cost,
+        # simulated-interval goodput with each, and the supervised
+        # injected-kill drill's measured time-to-recover (measure_recovery).
+        # Null when the stage was skipped or failed.
+        "recovery": None,
         # MFU-lever sweep (ROADMAP item 2 close-out): per-variant step_ms for
         # the chunked RSSM scan (rssm_chunks 2/4), scan_unroll=8 and the
         # Pallas LN-GRU vs the base graph (measure_mfu_levers; chip menu runs

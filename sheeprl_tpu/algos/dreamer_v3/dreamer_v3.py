@@ -955,9 +955,13 @@ def _dreamer_main(
             last_train = train_step_count
 
         # ---- checkpoint (reference dreamer_v3.py:795-826) -----------------
+        # a pending preemption (signal or drill) forces the branch: the save
+        # below IS the emergency snapshot (howto/resilience.md)
+        preempt_now = diag.preempt_due(iter_num)
         if (
             (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
             or cfg.dry_run
+            or preempt_now
             or (iter_num == total_iters and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step_count
@@ -980,6 +984,9 @@ def _dreamer_main(
                     replay_buffer=rb if cfg.buffer.checkpoint else None,
                 )
             diag.on_checkpoint(policy_step_count, ckpt_path)
+            if preempt_now:
+                envs.close()
+                diag.on_preempted(policy_step_count, iter_num, ckpt_path)
 
     envs.close()
     cumulative_rew = None

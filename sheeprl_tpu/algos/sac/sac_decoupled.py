@@ -283,9 +283,13 @@ def main(runtime, cfg):
             timer.reset()
             last_log = policy_step_count
 
+        # a pending preemption (signal or drill) forces the branch: the save
+        # below IS the emergency snapshot (howto/resilience.md)
+        preempt_now = diag.preempt_due(iter_num)
         if (
             (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
             or cfg.dry_run
+            or preempt_now
             or (iter_num == total_iters and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step_count
@@ -308,6 +312,9 @@ def main(runtime, cfg):
                     replay_buffer=rb if cfg.buffer.checkpoint else None,
                 )
             diag.on_checkpoint(policy_step_count, ckpt_path)
+            if preempt_now:
+                envs.close()
+                diag.on_preempted(policy_step_count, iter_num, ckpt_path)
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:

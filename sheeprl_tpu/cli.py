@@ -35,8 +35,20 @@ def resume_from_checkpoint(cfg: dotdict, overrides: Sequence[str] = ()) -> dotdi
     composed ones would silently revert every archived setting the user did
     not re-type to its group default (and could change observation shapes
     under the checkpoint).
+
+    ``checkpoint.resume_from`` may be a checkpoint file or any directory
+    above one (run dir, ``version_N``, checkpoint dir): selection is "newest
+    checkpoint whose manifest verifies" — corrupt/truncated/partial files are
+    skipped with a journaled ``ckpt_skipped`` reason, never crashed on
+    (howto/resilience.md).  The resolved file is protected from ``keep_last``
+    pruning for the lifetime of the resumed run.
     """
-    ckpt_path = pathlib.Path(cfg.checkpoint.resume_from)
+    from sheeprl_tpu.resilience.manifest import resolve_resume_from
+    from sheeprl_tpu.utils.checkpoint import protect_checkpoint
+
+    resolved = resolve_resume_from(str(cfg.checkpoint.resume_from))
+    protect_checkpoint(resolved)
+    ckpt_path = pathlib.Path(resolved)
     old_cfg_path = ckpt_path.parent.parent / "config.yaml"
     if not old_cfg_path.is_file():
         raise FileNotFoundError(
@@ -165,6 +177,21 @@ def check_configs(cfg: dotdict) -> None:
                 f"diagnostics.goodput.profile.max_ms must be >= 10 (the capture floor), "
                 f"got {max_ms!r}; set diagnostics.goodput.profile.enabled=False instead"
             )
+    # resilience knobs: validated here AND in the ResilienceMonitor ctor
+    # (direct entrypoint callers skip check_configs) — a zero snapshot-buffer
+    # depth would deadlock the first async submit
+    res_cfg = (cfg.get("diagnostics") or {}).get("resilience") or {}
+    max_pending = res_cfg.get("max_pending_snapshots")
+    if max_pending is not None and int(max_pending) < 1:
+        raise ValueError(
+            f"diagnostics.resilience.max_pending_snapshots must be >= 1, got {max_pending!r}"
+        )
+    inject_preempt = res_cfg.get("inject_preempt_iter")
+    if inject_preempt is not None and int(inject_preempt) < 1:
+        raise ValueError(
+            f"diagnostics.resilience.inject_preempt_iter must be >= 1 (1 = first "
+            f"iteration) or null, got {inject_preempt!r}"
+        )
     # learning-health knobs: validated here AND in the HealthMonitor ctor
     # (direct entrypoint callers skip check_configs) so a bad band/window
     # fails before the run dir exists
@@ -297,8 +324,13 @@ def run_algorithm(cfg: dotdict):
     except SentinelHalt:
         status = "halted"
         raise
-    except BaseException:
-        status = "aborted"
+    except BaseException as err:
+        from sheeprl_tpu.resilience.preemption import PreemptedExit
+
+        # a graceful preemption already journaled `preempted` and closed the
+        # facade with status="preempted" before raising; the close() in the
+        # finally block is idempotent, so "aborted" never overwrites it
+        status = "preempted" if isinstance(err, PreemptedExit) else "aborted"
         raise
     finally:
         # idempotent: a loop that finished cleanly already closed with

@@ -191,6 +191,13 @@ class Diagnostics:
             health = HealthMonitor(cfg or {})
             if health.enabled:
                 self.health = health
+        self.resilience = None
+        if self.enabled:
+            from sheeprl_tpu.resilience.monitor import ResilienceMonitor
+
+            resilience = ResilienceMonitor(cfg or {})
+            if resilience.enabled:
+                self.resilience = resilience
         self.journal: Optional[RunJournal] = None
         self.tracer = NullTracer()
         self.metrics_server = None
@@ -254,6 +261,13 @@ class Diagnostics:
                 # where it lives so restarts/post-mortems can account for
                 # compile time that never shows up
                 self.journal.write("compilation_cache", dir=str(self.compilation_cache_dir))
+        if self.resilience is not None:
+            # opened on every rank: each process of a decoupled topology must
+            # honor its own preemption signal; journal writes (ckpt_begin/
+            # ckpt_end, drained ckpt_skipped records) no-op off rank 0
+            self.resilience.open(
+                self._journal_event, self._journal_sync, rank_zero=self._rank_zero
+            )
         if self.memory is not None:
             # opened on every rank: the transfer guard must protect every
             # process; journal writes no-op off rank 0 (journal is None there)
@@ -352,6 +366,14 @@ class Diagnostics:
             for k, v in health["info"].items():
                 if v is not None:
                     info.setdefault(k, v)
+        if self.resilience is not None and self.resilience._opened:
+            res = self.resilience.snapshot()
+            snap.setdefault("gauges", {}).update(res["gauges"])
+            snap.setdefault("counters", {}).update(res["counters"])
+            info = snap.setdefault("info", {})
+            for k, v in res["info"].items():
+                if v is not None:
+                    info.setdefault(k, v)
         if self.journal is not None and self.journal.last_write_t is not None:
             import time
 
@@ -384,6 +406,11 @@ class Diagnostics:
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None
+        if self.resilience is not None:
+            # FIRST: drain the async checkpoint writer so a pending (possibly
+            # emergency) snapshot lands — and journals its ckpt_end — before
+            # run_end is written
+            self.resilience.close()
         goodput_open = self.goodput is not None and self.goodput._opened
         if goodput_open:
             # close BEFORE summarizing: the ended-transition folds the live
@@ -399,6 +426,8 @@ class Diagnostics:
                     summary.update(self.goodput.summary())
                 if self.health is not None and self.health._opened:
                     summary.update(self.health.summary())
+                if self.resilience is not None:
+                    summary.update(self.resilience.summary())
                 self.journal.write("telemetry_summary", **summary)
             if self.telemetry is not None:
                 self.telemetry.close()
@@ -532,6 +561,55 @@ class Diagnostics:
         if self.journal is not None:
             self.journal.write("checkpoint", step=step, path=str(path))
         self.tracer.instant("checkpoint", step=step)
+
+    # -- resilience hooks (ISSUE 13) ----------------------------------------
+    def save_checkpoint(self, path: str, state: Mapping[str, Any]) -> bool:
+        """Route one checkpoint save through the resilience layer (async
+        writer or blocking-with-journaling, manifest sidecar either way).
+        Returns False when the layer is off/unopened — the caller
+        (``Runtime.save``) then performs the plain synchronous save itself."""
+        if self.resilience is None or not self.resilience._opened:
+            return False
+        self.resilience.save(path, state)
+        return True
+
+    def preempt_due(self, iter_num: int) -> bool:
+        """True once a preemption (SIGTERM/SIGINT, or the
+        ``diagnostics.resilience.inject_preempt_iter`` drill) is pending.
+        The loop then forces its checkpoint branch — the emergency snapshot —
+        and calls :meth:`on_preempted` with the written path."""
+        return self.resilience is not None and self.resilience.preempt_due(iter_num)
+
+    def on_preempted(self, step: Optional[int], iter_num: int, ckpt_path: str) -> None:
+        """Finish a graceful preemption: drain the async writer FIRST (the
+        ``preempted`` record must not claim a snapshot that never landed),
+        journal the fsync'd ``preempted`` record with the observed durability,
+        close the run with status ``preempted`` and exit with the distinct
+        preemption code by raising :class:`PreemptedExit`."""
+        from sheeprl_tpu.resilience.preemption import PreemptedExit
+
+        reason = "preempt"
+        durable = True
+        if self.resilience is not None:
+            reason = self.resilience.preempt_reason
+            # bounded: a write slower than the flush timeout is abandoned at
+            # exit, and the record says so — resume selection only ever picks
+            # VERIFIED checkpoints, so a lost snapshot costs progress, not
+            # correctness
+            durable = self.resilience.flush()
+        self._journal_event(
+            "preempted",
+            step=step,
+            iter_num=int(iter_num),
+            path=str(ckpt_path),
+            reason=reason,
+            snapshot_durable=durable,
+        )
+        self._journal_sync()
+        self.close("preempted")
+        raise PreemptedExit(
+            f"preempted ({reason}) at iteration {iter_num}: emergency checkpoint {ckpt_path}"
+        )
 
     def _journal_divergence(self, event: Dict[str, Any]) -> None:
         if self.telemetry is not None:

@@ -236,6 +236,24 @@ def format_event_line(event: Dict[str, Any]) -> str:
             f"[{clock}] {kind:<12s} {payload.get('kind')} on {payload.get('subject')} cleared "
             f"at step {payload.get('step')} (active since step {payload.get('since_step')})"
         )
+    if kind == "ckpt_end":
+        if payload.get("status") == "failed":
+            return (
+                f"[{clock}] {'!! CKPT-FAIL':<12s} step {payload.get('step')}: "
+                f"{str(payload.get('error', ''))[:80]}"
+            )
+        mode = "blocking" if payload.get("blocking") else "async"
+        return (
+            f"[{clock}] {kind:<12s} step {payload.get('step')} "
+            f"{format_bytes(payload.get('bytes'))} in {payload.get('write_ms')}ms ({mode})"
+        )
+    if kind == "ckpt_skipped":
+        return f"[{clock}] {kind:<12s} {payload.get('path')}: {payload.get('reason')}"
+    if kind == "preempted":
+        return (
+            f"[{clock}] {'!! PREEMPT':<12s} {payload.get('reason')} at iter "
+            f"{payload.get('iter_num')}; emergency checkpoint {payload.get('path')}"
+        )
     if kind == "memory_breakdown":
         components = payload.get("components") or {}
         total = sum(v for v in components.values() if isinstance(v, (int, float)))
@@ -299,9 +317,108 @@ def status_block(events: List[Dict[str, Any]]) -> str:
     lines.append(f"events  {len(events)} total · {len(metrics_events)} intervals · "
                  f"{n_ckpt} checkpoints · {n_rec} recompiles · {n_div} divergences")
     lines.extend(goodput_status_lines(events, live=run_end is None))
+    lines.extend(checkpoint_status_lines(events, live=run_end is None))
     lines.extend(health_status_lines(events, live=run_end is None))
     lines.extend(memory_status_lines(events))
     return "\n".join(lines)
+
+
+#: A live run whose newest verified checkpoint is older than this many
+#: observed checkpoint intervals (with a 30 s floor) gets the
+#: ``!! NO-RECENT-CKPT`` banner — it would lose everything since then on a
+#: preemption.  Shared by the journal view here and run_monitor's --url mode.
+NO_RECENT_CKPT_INTERVALS = 3.0
+
+#: Banner fallback when no cadence is observable yet (a single checkpoint so
+#: far, or an endpoint that has not exported an interval): age alone past
+#: this hard ceiling still fires — the single-stuck-checkpoint run is exactly
+#: the case the banner exists for.
+NO_RECENT_CKPT_FALLBACK_S = 1800.0
+
+
+def no_recent_ckpt_banner(age_s: Optional[float], cadence_s: Optional[float]) -> Optional[str]:
+    """The ``!! NO-RECENT-CKPT`` banner line (or None): ONE owner for the
+    threshold/wording so the journal view and run_monitor's endpoint mode
+    can never drift."""
+    if age_s is None:
+        return None
+    if cadence_s:
+        if age_s > max(30.0, NO_RECENT_CKPT_INTERVALS * cadence_s):
+            return (
+                f"!! NO-RECENT-CKPT — newest verified checkpoint is {age_s:.0f}s old "
+                f"(~{age_s / cadence_s:.0f} intervals); a preemption now loses everything since"
+            )
+        return None
+    if age_s > NO_RECENT_CKPT_FALLBACK_S:
+        return (
+            f"!! NO-RECENT-CKPT — newest verified checkpoint is {age_s:.0f}s old "
+            "(no cadence observed yet); a preemption now loses everything since"
+        )
+    return None
+
+
+def _median(values: List[float]) -> Optional[float]:
+    values = sorted(v for v in values if isinstance(v, (int, float)) and v > 0)
+    if not values:
+        return None
+    return values[len(values) // 2]
+
+
+def checkpoint_status_lines(events: List[Dict[str, Any]], live: bool = True) -> List[str]:
+    """The checkpoint-freshness panel (run_monitor + journal_report share
+    it): newest checkpoint step/age, verified-write counters from the
+    resilience layer's ``ckpt_end`` events, mean write cost, and — live mode
+    only — the ``!! NO-RECENT-CKPT`` banner when the newest verified
+    checkpoint is older than :data:`NO_RECENT_CKPT_INTERVALS` observed
+    checkpoint intervals.  Empty when the run journaled no checkpoints."""
+    writes = [
+        e
+        for e in events
+        if e.get("event") == "ckpt_end" and e.get("status", "ok") == "ok"
+    ]
+    failures = sum(1 for e in events if e.get("event") == "ckpt_end" and e.get("status") == "failed")
+    plain = [e for e in events if e.get("event") == "checkpoint"]
+    marks = writes or plain
+    if not marks:
+        return []
+    newest = max(marks, key=lambda e: e.get("t") or 0.0)
+    step = newest.get("step")
+    parts = [f"{len(marks)} written"]
+    if step is not None:
+        parts.append(f"last step {step}")
+    verified = [e for e in writes if e.get("verified")]
+    if verified:
+        v_step = max(verified, key=lambda e: e.get("t") or 0.0).get("step")
+        if v_step is not None and v_step != step:
+            parts.append(f"last verified step {v_step}")
+        elif v_step is not None:
+            parts.append("verified")
+    write_ms = [e.get("write_ms") for e in writes if isinstance(e.get("write_ms"), (int, float))]
+    if write_ms:
+        mode = "async" if any(e.get("blocking") is False for e in writes) else "blocking"
+        parts.append(f"mean write {sum(write_ms) / len(write_ms):.0f}ms {mode}")
+    if failures:
+        parts.append(f"{failures} FAILED")
+    age = None
+    newest_t = newest.get("t")
+    if isinstance(newest_t, (int, float)):
+        age = max(0.0, time.time() - newest_t)
+        if live:
+            parts.append(f"age {age:.0f}s")
+    lines = ["ckpts   " + " · ".join(parts)]
+    if live:
+        ts = sorted(e.get("t") for e in marks if isinstance(e.get("t"), (int, float)))
+        cadence = _median([b - a for a, b in zip(ts, ts[1:])])
+        if cadence is None:
+            # single checkpoint so far: fall back to the metric-interval pace
+            mt = sorted(
+                e.get("t") for e in events if e.get("event") == "metrics" and isinstance(e.get("t"), (int, float))
+            )
+            cadence = _median([b - a for a, b in zip(mt, mt[1:])])
+        banner = no_recent_ckpt_banner(age, cadence)
+        if banner is not None:
+            lines.append(banner)
+    return lines
 
 
 def goodput_status_lines(events: List[Dict[str, Any]], live: bool = True) -> List[str]:

@@ -62,7 +62,12 @@ EVENT_KINDS: Dict[str, str] = {
     "serve_start": "the policy server came up: algo, served checkpoint/step, bind address, batch buckets, watched dir",
     "ckpt_promote": "hot-reload promoted a new checkpoint (step, path, params version) — atomic swap, no recompile",
     "ckpt_reject": "hot-reload refused a checkpoint: health-gate anomalies, shape mismatch, or missing journal",
-    "run_end": "completed / halted / aborted — absent after a kill",
+    "ckpt_begin": "a checkpoint write started (path, step, blocking flag, seconds queued behind the async writer)",
+    "ckpt_end": "a checkpoint write finished: bytes, write ms, manifest verified — or status=failed with the error",
+    "ckpt_skipped": "resume selection rejected a checkpoint (corrupt / truncated / unreadable) with the reason",
+    "preempted": "graceful preemption: emergency snapshot landed at a loop boundary; the process exits with code 75 (fsync'd)",
+    "restart": "supervisor respawned the run after a non-clean exit: attempt, rc, backoff, measured downtime, resume source",
+    "run_end": "completed / halted / aborted / preempted — absent after a kill",
 }
 
 #: Journal event kinds emitted by the memory monitor (handler routing in the
@@ -104,6 +109,11 @@ METRICS: Dict[str, str] = {
     "sheeprl_profile_captures_total": "successful jax.profiler captures (auto on stall + /profile)",
     # learning-health counters (HealthMonitor.snapshot()["counters"])
     "sheeprl_health_anomalies_total": "anomaly events journaled by the learning-health detectors",
+    # resilience counters (ResilienceMonitor.snapshot()["counters"])
+    "sheeprl_ckpts_written_total": "checkpoints written (async or blocking) with a verified manifest sidecar",
+    "sheeprl_ckpt_failures_total": "checkpoint writes that failed (journaled as ckpt_end status=failed)",
+    "sheeprl_ckpt_write_seconds_total": "cumulative serialize+fsync wall-clock spent writing checkpoints",
+    "sheeprl_restarts_total": "kill/resume cycles the supervisor performed before this process (SHEEPRL_SUPERVISOR_RESTARTS)",
     # interval gauges (Telemetry/... keys, prefix-stripped and sanitized)
     "sheeprl_mfu": "model FLOPs utilization vs the device-kind peak",
     "sheeprl_tflops_per_sec": "achieved TFLOP/s over the last interval",
@@ -118,6 +128,11 @@ METRICS: Dict[str, str] = {
     "sheeprl_phase_pct_fetch": "interval wall-clock share: metric/buffer fetch",
     "sheeprl_phase_pct_other": "interval wall-clock share: other instrumented spans",
     "sheeprl_phase_pct_idle": "interval wall-clock share: un-instrumented host time",
+    # resilience gauges (checkpoint freshness; run_monitor --url keys its
+    # !! NO-RECENT-CKPT banner off these)
+    "sheeprl_ckpt_last_step": "policy step of the newest verified checkpoint written by this run",
+    "sheeprl_ckpt_age_seconds": "seconds since the newest verified checkpoint landed on disk",
+    "sheeprl_ckpt_interval_seconds": "seconds between the last two checkpoint writes (the observed cadence)",
     # goodput gauges (run lifecycle layer, prefix-stripped)
     "sheeprl_run_state": "run-state machine index into goodput.STATES (5 = stalled)",
     "sheeprl_goodput": "cumulative productive share since open: train-span seconds / wall seconds",

@@ -168,10 +168,18 @@ class Runtime:
 
     # -- checkpoint io ------------------------------------------------------
     def save(self, path: str, state: Dict[str, Any]) -> None:
-        from sheeprl_tpu.utils.checkpoint import save_state
-
+        """Checkpoint write, routed through the resilience layer when the
+        diagnostics facade carries one (async off-critical-path writer +
+        manifest sidecar + ckpt_begin/ckpt_end journaling); otherwise a plain
+        synchronous save that still writes the manifest, so resume-time
+        verification works for every producer (eval helpers, tests, bench)."""
         if self.is_global_zero:
-            save_state(path, state)
+            diagnostics = self.diagnostics
+            routed = diagnostics is not None and diagnostics.save_checkpoint(path, state)
+            if not routed:
+                from sheeprl_tpu.resilience.manifest import save_verified_checkpoint
+
+                save_verified_checkpoint(path, state)
         self.barrier()
 
     def load(self, path: str) -> Dict[str, Any]:

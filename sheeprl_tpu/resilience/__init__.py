@@ -1,0 +1,74 @@
+"""Preemption-tolerant elastic training (ISSUE 13 — the elasticity half of
+ROADMAP item 4).
+
+The goodput layer (:mod:`sheeprl_tpu.diagnostics.goodput`) *measures* whether
+a run survives preemptible pools; this package makes it *survive* them.  Four
+pillars, wired through the :class:`~sheeprl_tpu.diagnostics.Diagnostics`
+facade and ``Runtime.save``:
+
+* :mod:`~sheeprl_tpu.resilience.manifest` — validated checkpoints: every save
+  writes a ``<ckpt>.manifest.json`` sidecar (content digest, step, param-tree
+  shapes/dtypes, code fingerprint reusing the AOT-cache fingerprint helpers);
+  resume selection becomes "newest checkpoint whose manifest verifies"
+  instead of the old second-newest-by-mtime heuristic, and corrupt/truncated
+  checkpoints are skipped with a journaled ``ckpt_skipped`` reason, never
+  crashed on;
+* :mod:`~sheeprl_tpu.resilience.async_writer` — async off-critical-path
+  checkpointing: the train loop pays one cheap device→host snapshot
+  (``jax.device_get`` + a host-buffer copy, double-buffered with
+  backpressure) and a background thread serializes/fsyncs through the
+  existing atomic tmp+rename in ``utils/checkpoint.py::save_state``,
+  journaling ``ckpt_begin``/``ckpt_end`` with write duration and bytes so
+  checkpoint cost disappears from the goodput train spans;
+* :mod:`~sheeprl_tpu.resilience.preemption` — graceful preemption: a
+  SIGTERM/SIGINT handler requests an emergency snapshot at the next loop
+  boundary; the loop saves, journals a fsync'd ``preempted`` event and exits
+  with :data:`~sheeprl_tpu.resilience.preemption.PREEMPTED_EXIT_CODE` (75,
+  EX_TEMPFAIL) so a supervisor can tell "preempted, resume me" from a crash;
+  ``diagnostics.resilience.inject_preempt_iter`` drills the chain through
+  the real CLI;
+* :mod:`~sheeprl_tpu.resilience.supervisor` — auto-restart supervisor
+  (``tools/supervise.py`` / ``sheeprl-supervise``): wraps ``cli.run`` as a
+  child process, restarts on non-clean exit with capped exponential backoff
+  and a restart budget, resumes from the newest *verified* checkpoint, and
+  journals ``restart`` events into ``<run dir>/supervisor.jsonl`` so
+  ``tools/goodput_report.py`` measures time-to-recover on real kill/resume
+  cycles.
+
+The :class:`~sheeprl_tpu.resilience.monitor.ResilienceMonitor` ties the
+pillars to the facade (journal hooks, ``/metrics`` counters, config knobs
+under ``diagnostics.resilience``).  See ``howto/resilience.md``.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.resilience.async_writer import AsyncCheckpointWriter, host_snapshot
+from sheeprl_tpu.resilience.manifest import (
+    MANIFEST_SUFFIX,
+    newest_verified_checkpoint,
+    read_manifest,
+    reap_orphan_tmps,
+    resolve_resume_from,
+    save_verified_checkpoint,
+    verify_checkpoint,
+    write_manifest,
+)
+from sheeprl_tpu.resilience.monitor import ResilienceMonitor
+from sheeprl_tpu.resilience.preemption import PREEMPTED_EXIT_CODE, PreemptedExit, PreemptionGuard
+
+__all__ = [
+    "AsyncCheckpointWriter",
+    "MANIFEST_SUFFIX",
+    "PREEMPTED_EXIT_CODE",
+    "PreemptedExit",
+    "PreemptionGuard",
+    "ResilienceMonitor",
+    "host_snapshot",
+    "newest_verified_checkpoint",
+    "read_manifest",
+    "reap_orphan_tmps",
+    "resolve_resume_from",
+    "save_verified_checkpoint",
+    "verify_checkpoint",
+    "write_manifest",
+]

@@ -1,0 +1,311 @@
+"""Validated checkpoints: manifest sidecars + verified resume selection.
+
+Every checkpoint save writes a ``<ckpt>.manifest.json`` sidecar *after* the
+checkpoint itself has atomically landed:
+
+``{"format": 1, "step": 128, "bytes": N, "sha256": "...", "tree": {path:
+[shape, dtype]}, "fingerprint": "<code fingerprint>", "written_t": ...}``
+
+The sidecar is what makes "is this checkpoint complete and uncorrupted?"
+answerable without unpickling it: a SIGKILL mid-write leaves only a
+``*.ckpt.tmp`` (the tmp+rename in ``utils/checkpoint.py::save_state`` is
+atomic), and external corruption/truncation fails the size/digest check.
+Resume selection (:func:`newest_verified_checkpoint`) walks candidates
+newest-first by step and returns the first one that verifies, collecting a
+``(path, reason)`` skip record for every rejected sibling — the facade
+journals those as ``ckpt_skipped`` events once the run journal opens.
+
+Checkpoints written before this module existed carry no manifest; they are
+"legacy": shallow verification accepts them (a non-empty file), deep
+verification falls back to actually unpickling them.  The mtime-second-newest
+resume heuristic this replaces is documented in the ISSUE-8 SIGKILL e2e.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+MANIFEST_SUFFIX = ".manifest.json"
+MANIFEST_FORMAT = 1
+
+_STEP_RE = re.compile(r"ckpt_(\d+)")
+
+#: Journal events queued before the run journal exists (resume selection runs
+#: at config-compose time); ``ResilienceMonitor.open`` drains them.
+_PENDING_JOURNAL: List[Tuple[str, Dict[str, Any]]] = []
+
+
+def queue_journal_event(kind: str, **fields: Any) -> None:
+    _PENDING_JOURNAL.append((kind, dict(fields)))
+
+
+def drain_journal_events() -> List[Tuple[str, Dict[str, Any]]]:
+    out = list(_PENDING_JOURNAL)
+    _PENDING_JOURNAL.clear()
+    return out
+
+
+def manifest_path(ckpt_path: str) -> str:
+    return str(ckpt_path) + MANIFEST_SUFFIX
+
+
+def checkpoint_step(ckpt_path: str, state: Optional[Mapping[str, Any]] = None) -> Optional[int]:
+    """Policy step of a checkpoint: the ``ckpt_<step>_<rank>.ckpt`` filename
+    convention first, state counters (``policy_step``/``iter_num``) second."""
+    match = _STEP_RE.search(os.path.basename(str(ckpt_path)))
+    if match:
+        return int(match.group(1))
+    if state is not None:
+        for key in ("policy_step", "update", "iter_num"):
+            value = state.get(key)
+            if isinstance(value, (int, float)):
+                return int(value)
+    return None
+
+
+def _file_digest(path: str, chunk_bytes: int = 1 << 20) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as fp:
+        while True:
+            block = fp.read(chunk_bytes)
+            if not block:
+                break
+            digest.update(block)
+    return digest.hexdigest()
+
+
+def tree_spec(state: Mapping[str, Any]) -> Dict[str, List[Any]]:
+    """``{dotted-path: [shape, dtype]}`` for every array leaf of the state —
+    the manifest's structural record, checked by serving/resume consumers
+    that care about shape drift (verification itself uses the content
+    digest; a spec mismatch is a *different* checkpoint, not a corrupt one)."""
+    out: Dict[str, List[Any]] = {}
+
+    def walk(node: Any, prefix: str) -> None:
+        if isinstance(node, Mapping):
+            for key, value in node.items():
+                walk(value, f"{prefix}.{key}" if prefix else str(key))
+            return
+        if isinstance(node, (list, tuple)):
+            for i, value in enumerate(node):
+                walk(value, f"{prefix}[{i}]")
+            return
+        shape = getattr(node, "shape", None)
+        dtype = getattr(node, "dtype", None)
+        if shape is not None and dtype is not None:
+            out[prefix] = [list(shape), str(dtype)]
+
+    walk(state, "")
+    return out
+
+
+def _code_fingerprint() -> str:
+    """Code-revision stamp reusing the AOT-cache fingerprint helper (PR 10):
+    package version + git HEAD.  Informational — resuming across revisions is
+    legitimate, so a mismatch is recorded, never fatal."""
+    try:
+        from sheeprl_tpu.diagnostics.telemetry import _code_fingerprint as fp
+
+        return fp()
+    except Exception:  # pragma: no cover - never block a save on this
+        return "?"
+
+
+def write_manifest(
+    ckpt_path: str,
+    state: Optional[Mapping[str, Any]] = None,
+    step: Optional[int] = None,
+    digest: Optional[Mapping[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Write the sidecar for an already-landed checkpoint (atomic tmp+rename;
+    a crash can only leave a checkpoint *without* a manifest — i.e. legacy,
+    still resumable — never a manifest describing a half-written file).
+    ``digest`` is the ``{"sha256", "bytes"}`` record ``save_state`` computed
+    while streaming the pickle out; without it the file is re-read."""
+    ckpt_path = str(ckpt_path)
+    entry: Dict[str, Any] = {
+        "format": MANIFEST_FORMAT,
+        "step": step if step is not None else checkpoint_step(ckpt_path, state),
+        "bytes": digest["bytes"] if digest else os.path.getsize(ckpt_path),
+        "sha256": digest["sha256"] if digest else _file_digest(ckpt_path),
+        "fingerprint": _code_fingerprint(),
+        "written_t": round(time.time(), 3),
+    }
+    if state is not None:
+        entry["tree"] = tree_spec(state)
+    out_path = manifest_path(ckpt_path)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fp:
+        json.dump(entry, fp)
+        fp.flush()
+        os.fsync(fp.fileno())
+    os.replace(tmp, out_path)
+    return entry
+
+
+def read_manifest(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    """The sidecar dict, or None when absent/unparseable (both mean "treat the
+    checkpoint as legacy" — verification then needs the pickle fallback)."""
+    path = manifest_path(ckpt_path)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as fp:
+            entry = json.load(fp)
+        return entry if isinstance(entry, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def verify_checkpoint(ckpt_path: str, deep: bool = True) -> Tuple[bool, str]:
+    """``(ok, reason)`` for one checkpoint file.
+
+    * manifest present — shallow checks existence + byte size (O(1), used by
+      pruning), deep additionally re-digests the content (used by resume);
+    * no/corrupt manifest (legacy) — shallow accepts any non-empty file, deep
+      attempts the actual unpickle;
+    * every failure mode is a *reason string*, never an exception.
+    """
+    ckpt_path = str(ckpt_path)
+    if not os.path.isfile(ckpt_path):
+        return False, "missing"
+    size = os.path.getsize(ckpt_path)
+    if size == 0:
+        return False, "empty"
+    entry = read_manifest(ckpt_path)
+    if entry is None:
+        if not deep:
+            return True, "legacy"
+        try:
+            from sheeprl_tpu.utils.checkpoint import load_state
+
+            load_state(ckpt_path)
+            return True, "legacy"
+        except Exception as err:
+            return False, f"unreadable:{type(err).__name__}"
+    if entry.get("bytes") != size:
+        return False, "size_mismatch"
+    if deep and entry.get("sha256") != _file_digest(ckpt_path):
+        return False, "digest_mismatch"
+    return True, "verified"
+
+
+def save_verified_checkpoint(
+    path: str, state: Mapping[str, Any], step: Optional[int] = None
+) -> Dict[str, Any]:
+    """Atomic checkpoint save + manifest sidecar; returns
+    ``{path, step, bytes, write_ms}`` (the payload of a ``ckpt_end`` event).
+    The content digest is computed while the pickle streams out — the
+    checkpoint is never read back."""
+    from sheeprl_tpu.utils.checkpoint import save_state
+
+    t0 = time.perf_counter()
+    digest = save_state(path, state, digest=True)
+    entry = write_manifest(path, state=state, step=step, digest=digest)
+    return {
+        "path": str(path),
+        "step": entry["step"],
+        "bytes": entry["bytes"],
+        "write_ms": round((time.perf_counter() - t0) * 1e3, 3),
+    }
+
+
+def _sort_key(path: Path) -> Tuple[int, float]:
+    step = checkpoint_step(str(path))
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = 0.0
+    return (step if step is not None else -1, mtime)
+
+
+def list_checkpoints(root: str) -> List[str]:
+    """All ``*.ckpt`` files under ``root`` (a file passes through), newest
+    first — by parsed step, mtime breaking ties (mtime alone lies when a
+    restore or copy touches files)."""
+    p = Path(root)
+    if p.is_file():
+        return [str(p)]
+    if not p.is_dir():
+        return []
+    return [str(c) for c in sorted(p.rglob("*.ckpt"), key=_sort_key, reverse=True)]
+
+
+def newest_verified_checkpoint(
+    root: str, deep: bool = True
+) -> Tuple[Optional[str], List[Dict[str, str]]]:
+    """The newest checkpoint under ``root`` that verifies, plus a skip record
+    for every newer sibling that did not — the "never crash on a corrupt
+    checkpoint" resume rule in one place."""
+    skipped: List[Dict[str, str]] = []
+    for candidate in list_checkpoints(root):
+        ok, reason = verify_checkpoint(candidate, deep=deep)
+        if ok:
+            return candidate, skipped
+        skipped.append({"path": candidate, "reason": reason})
+    return None, skipped
+
+
+def reap_orphan_tmps(root: str, max_age_s: float = 0.0) -> List[str]:
+    """Delete ``*.ckpt.tmp`` / manifest ``.tmp`` leftovers of interrupted
+    writes under ``root``.  ``max_age_s`` guards against reaping a write that
+    is legitimately in flight (pruning passes a generous age; resume passes 0
+    — the previous process is definitionally dead)."""
+    p = Path(root)
+    if not p.is_dir():
+        return []
+    now = time.time()
+    reaped: List[str] = []
+    for pattern in ("*.ckpt.tmp", f"*{MANIFEST_SUFFIX}.tmp"):
+        for tmp in p.rglob(pattern):
+            try:
+                if now - os.path.getmtime(tmp) < max_age_s:
+                    continue
+                tmp.unlink()
+                reaped.append(str(tmp))
+            except OSError:  # pragma: no cover - racing writer/reaper
+                continue
+    return reaped
+
+
+def resolve_resume_from(spec: str) -> str:
+    """Resolve ``checkpoint.resume_from`` to a verified checkpoint file.
+
+    A directory (run dir, ``version_N`` dir, or checkpoint dir) selects the
+    newest checkpoint whose manifest verifies, queueing a ``ckpt_skipped``
+    journal event per rejected sibling; an explicit file must itself verify.
+    Interrupted-write ``.tmp`` files never match the ``*.ckpt`` selection and
+    are simply ignored — reaping them is ``keep_last`` pruning's (age-guarded)
+    job, because the donor run may still be alive and mid-write (resuming
+    *from* a live run's directory is a supported way to fork it).
+    """
+    path = Path(str(spec))
+    # discard events queued by a previous resolution this process never
+    # journaled (e.g. a diagnostics-off run): they describe the wrong resume
+    _PENDING_JOURNAL.clear()
+    if path.is_dir():
+        best, skipped = newest_verified_checkpoint(str(path), deep=True)
+        for skip in skipped:
+            queue_journal_event("ckpt_skipped", **skip)
+        if best is None:
+            raise FileNotFoundError(
+                f"No verifiable checkpoint under '{spec}' "
+                f"({len(skipped)} candidate(s) rejected: "
+                f"{[s['reason'] for s in skipped[:5]]})"
+            )
+        return best
+    if not path.is_file():
+        raise FileNotFoundError(f"Checkpoint '{spec}' does not exist")
+    ok, reason = verify_checkpoint(str(path), deep=True)
+    if not ok:
+        raise ValueError(
+            f"Checkpoint '{spec}' fails verification ({reason}); pass its run "
+            "directory instead to resume from the newest verified checkpoint"
+        )
+    return str(path)

@@ -15,20 +15,69 @@ from __future__ import annotations
 import os
 import pickle
 from pathlib import Path
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence, Set
 
 import numpy as np
 
 from sheeprl_tpu.utils.utils import npify
 
+#: Interrupted-write ``*.ckpt.tmp`` files older than this are reaped during
+#: ``keep_last`` pruning (younger ones may belong to the live async writer;
+#: resume-time reaping uses age 0 — the previous process is dead by then).
+TMP_ORPHAN_AGE_S = 900.0
 
-def save_state(path: str, state: Dict[str, Any]) -> None:
+#: Checkpoints ``keep_last`` pruning must never delete: the file the current
+#: run resumed from (``cli.resume_from_checkpoint`` registers it) — deleting
+#: the resume source mid-run would leave a crash before the first fresh save
+#: with nothing to fall back to.
+PROTECTED_CHECKPOINTS: Set[str] = set()
+
+
+def protect_checkpoint(path: str) -> None:
+    PROTECTED_CHECKPOINTS.add(os.path.abspath(str(path)))
+
+
+class _HashingWriter:
+    """File-object shim that sha256-digests bytes as pickle streams them out,
+    so manifest writing never has to re-read the checkpoint from disk."""
+
+    def __init__(self, fp):
+        import hashlib
+
+        self._fp = fp
+        self.sha = hashlib.sha256()
+        self.nbytes = 0
+
+    def write(self, data) -> int:
+        # protocol-5 pickling hands PickleBuffer objects to write(); a
+        # memoryview normalizes anything bytes-like for hashing + counting
+        view = memoryview(data)
+        self.sha.update(view)
+        self.nbytes += view.nbytes
+        return self._fp.write(data)
+
+
+def save_state(path: str, state: Dict[str, Any], digest: bool = False) -> Optional[Dict[str, Any]]:
+    """Atomic tmp+rename checkpoint write, fsync'd before the rename so a
+    power cut cannot promote an empty rename target (a SIGKILL alone could
+    only ever leave the ``.tmp``).  With ``digest=True`` returns
+    ``{"sha256", "bytes"}`` computed while streaming — the manifest sidecar's
+    content record at zero extra disk I/O."""
     path = str(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as fp:
-        pickle.dump(npify(state), fp, protocol=pickle.HIGHEST_PROTOCOL)
+        sink = _HashingWriter(fp) if digest else fp
+        pickle.dump(npify(state), sink, protocol=pickle.HIGHEST_PROTOCOL)
+        fp.flush()
+        try:
+            os.fsync(fp.fileno())
+        except OSError:  # pragma: no cover - exotic filesystems
+            pass
     os.replace(tmp, path)
+    if digest:
+        return {"sha256": sink.sha.hexdigest(), "bytes": sink.nbytes}
+    return None
 
 
 def load_state(path: str) -> Dict[str, Any]:
@@ -118,7 +167,36 @@ class CheckpointCallback:
         self._saved_trunc = None
 
     def _delete_old_checkpoints(self, ckpt_folder: Path) -> None:
-        """`keep_last` pruning (reference callback.py:145-148)."""
+        """`keep_last` pruning (reference callback.py:145-148), elasticity-safe:
+
+        * the checkpoint the current run resumed from survives
+          (:data:`PROTECTED_CHECKPOINTS`);
+        * the last *verified* checkpoint survives — if none of the keepers
+          passes (shallow) manifest verification, the newest verified one in
+          the delete set is spared, so resume always has a valid target;
+        * orphaned ``.tmp`` files from interrupted writes are reaped (age-
+          guarded: the async writer may legitimately own a young one);
+        * a deleted checkpoint takes its manifest sidecar with it.
+        """
+        from sheeprl_tpu.resilience.manifest import (
+            MANIFEST_SUFFIX,
+            reap_orphan_tmps,
+            verify_checkpoint,
+        )
+
+        reap_orphan_tmps(str(ckpt_folder), max_age_s=TMP_ORPHAN_AGE_S)
         ckpts = sorted(ckpt_folder.glob("*.ckpt"), key=os.path.getmtime)
-        for old in ckpts[: -self.keep_last]:
+        keepers, doomed = ckpts[-self.keep_last :], ckpts[: -self.keep_last]
+        if not doomed:
+            return
+        spared: Set[str] = set()
+        if not any(verify_checkpoint(str(p), deep=False)[0] for p in keepers):
+            for candidate in reversed(doomed):
+                if verify_checkpoint(str(candidate), deep=False)[0]:
+                    spared.add(str(candidate))
+                    break
+        for old in doomed:
+            if str(old) in spared or os.path.abspath(old) in PROTECTED_CHECKPOINTS:
+                continue
             old.unlink(missing_ok=True)
+            Path(str(old) + MANIFEST_SUFFIX).unlink(missing_ok=True)

@@ -710,9 +710,11 @@ def test_cli_ppo_live_goodput_gauge_and_injected_stall_drill(run_cli, tmp_path):
 
 
 def test_cli_killed_segment_resume_and_goodput_report(run_cli):
-    """Acceptance: SIGKILL a run mid-training, resume from its checkpoint,
-    and ``goodput_report`` shows two segments — the older one KILLED with
-    non-zero recovered productive time — plus the time-to-recover gap."""
+    """Acceptance: SIGKILL a run mid-training, resume via manifest-verified
+    newest-checkpoint selection (a planted corrupt newest checkpoint is
+    skipped with a journaled reason), and ``goodput_report`` shows two
+    segments — the older one KILLED with non-zero recovered productive
+    time — plus the time-to-recover gap."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen(
         [
@@ -754,13 +756,18 @@ def test_cli_killed_segment_resume_and_goodput_report(run_cli):
             proc.send_signal(signal.SIGKILL)
             proc.wait(timeout=60)
 
-    # pick the resume point AFTER the kill: checkpoint.keep_last reaps older
-    # files while the run lives, so anything chosen pre-kill may be gone.
-    # The newest file can be a partial write from the SIGKILL instant — the
-    # second-newest is guaranteed complete (its successor exists).
+    # resume selection is manifest-verified (ISSUE 13): pass the run DIR and
+    # let "newest checkpoint whose manifest verifies" pick the resume point —
+    # a SIGKILL mid-write can only leave a *.ckpt.tmp (ignored and reaped),
+    # and a planted corrupt newest checkpoint must be skipped with a
+    # journaled reason, never crashed on
     ckpts = sorted(run_dir.rglob("*.ckpt"), key=os.path.getmtime)
     assert ckpts, "killed run left no checkpoint"
-    ckpt = str(ckpts[-2] if len(ckpts) >= 2 else ckpts[-1])
+    newest_step = max(
+        int(p.name.split("_")[1]) for p in ckpts if p.name.split("_")[1].isdigit()
+    )
+    planted = ckpts[-1].parent / f"ckpt_{newest_step + 16}_0.ckpt"
+    planted.write_bytes(b"corrupt planted newest checkpoint")
 
     # resume from the kill point: same pinned run_name -> version_1 lands in
     # the same run dir; dry_run IS in the resume-override allowlist, so the
@@ -769,11 +776,16 @@ def test_cli_killed_segment_resume_and_goodput_report(run_cli):
         *PPO_TINY,
         "run_name=goodput_segments",
         "dry_run=True",
-        f"checkpoint.resume_from={ckpt}",
+        f"checkpoint.resume_from={run_dir}",
     )
 
     journals = collect_journals([str(run_dir)])
     assert len(journals) == 2, journals
+    # the planted corrupt newest was skipped with a journaled reason and the
+    # resumed segment started from a VERIFIED checkpoint
+    resumed_events = read_journal(journals[-1])
+    (skip,) = [e for e in resumed_events if e["event"] == "ckpt_skipped"]
+    assert skip["path"] == str(planted) and skip["reason"].startswith("unreadable")
     report = subprocess.run(
         [sys.executable, str(REPO_ROOT / "tools" / "goodput_report.py"), str(run_dir), "--json"],
         capture_output=True,

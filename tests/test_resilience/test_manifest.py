@@ -1,0 +1,205 @@
+"""Validated-checkpoint units: manifest roundtrip, corrupt/truncated/partial
+selection, orphan-tmp reaping, and the elasticity-safe ``keep_last`` pruning
+(ISSUE 13 satellites 1–2)."""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience.manifest import (
+    MANIFEST_SUFFIX,
+    checkpoint_step,
+    drain_journal_events,
+    manifest_path,
+    newest_verified_checkpoint,
+    read_manifest,
+    reap_orphan_tmps,
+    resolve_resume_from,
+    save_verified_checkpoint,
+    verify_checkpoint,
+    write_manifest,
+)
+from sheeprl_tpu.utils.checkpoint import (
+    PROTECTED_CHECKPOINTS,
+    CheckpointCallback,
+    load_state,
+    protect_checkpoint,
+    save_state,
+)
+
+
+def _state(step: int):
+    return {"agent": {"w": np.arange(8, dtype=np.float32) * step}, "policy_step": step}
+
+
+def test_manifest_roundtrip_records_digest_step_tree_and_fingerprint(tmp_path):
+    path = str(tmp_path / "ckpt_128_0.ckpt")
+    result = save_verified_checkpoint(path, _state(128))
+    assert result["step"] == 128 and result["bytes"] == os.path.getsize(path)
+    entry = read_manifest(path)
+    assert entry["step"] == 128
+    assert entry["bytes"] == os.path.getsize(path)
+    assert len(entry["sha256"]) == 64
+    assert entry["tree"]["agent.w"] == [[8], "float32"]
+    assert entry["fingerprint"]  # code revision stamp (informational)
+    assert verify_checkpoint(path, deep=True) == (True, "verified")
+    assert verify_checkpoint(path, deep=False) == (True, "verified")
+
+
+def test_truncated_and_corrupt_checkpoints_fail_verification(tmp_path):
+    path = str(tmp_path / "ckpt_16_0.ckpt")
+    save_verified_checkpoint(path, _state(16))
+    original = Path(path).read_bytes()
+    # truncation changes the size: caught even by the shallow check
+    Path(path).write_bytes(original[: len(original) // 2])
+    assert verify_checkpoint(path, deep=False) == (False, "size_mismatch")
+    # same-size corruption: only the deep digest check catches it
+    Path(path).write_bytes(b"\0" * len(original))
+    assert verify_checkpoint(path, deep=False) == (True, "verified")
+    assert verify_checkpoint(path, deep=True) == (False, "digest_mismatch")
+    # missing / empty
+    assert verify_checkpoint(str(tmp_path / "nope.ckpt"))[1] == "missing"
+    (tmp_path / "empty.ckpt").touch()
+    assert verify_checkpoint(str(tmp_path / "empty.ckpt"))[1] == "empty"
+
+
+def test_legacy_checkpoint_without_manifest_still_resumable(tmp_path):
+    path = str(tmp_path / "ckpt_8_0.ckpt")
+    save_state(path, _state(8))  # pre-ISSUE-13 producer: no sidecar
+    assert verify_checkpoint(path, deep=False) == (True, "legacy")
+    assert verify_checkpoint(path, deep=True) == (True, "legacy")
+    # a corrupt legacy file fails the deep (unpickle) check, not crash
+    Path(path).write_bytes(b"garbage")
+    ok, reason = verify_checkpoint(path, deep=True)
+    assert not ok and reason.startswith("unreadable:")
+
+
+def test_newest_verified_selection_skips_planted_corrupt_newest(tmp_path):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    save_verified_checkpoint(str(ckpt_dir / "ckpt_16_0.ckpt"), _state(16))
+    save_verified_checkpoint(str(ckpt_dir / "ckpt_32_0.ckpt"), _state(32))
+    # the planted newest: garbage content with a stale (lying) manifest
+    bad = ckpt_dir / "ckpt_48_0.ckpt"
+    bad.write_bytes(b"corrupt")
+    with open(manifest_path(str(bad)), "w") as fp:
+        json.dump({"format": 1, "step": 48, "bytes": 12345, "sha256": "0" * 64}, fp)
+    best, skipped = newest_verified_checkpoint(str(tmp_path))
+    assert best == str(ckpt_dir / "ckpt_32_0.ckpt")
+    assert [s["reason"] for s in skipped] == ["size_mismatch"]
+    # resolve_resume_from queues the skips as journal events
+    drain_journal_events()
+    assert resolve_resume_from(str(tmp_path)) == best
+    pending = drain_journal_events()
+    assert pending == [("ckpt_skipped", {"path": str(bad), "reason": "size_mismatch"})]
+
+
+def test_resolve_ignores_interrupted_write_tmp_and_pruning_reaps(tmp_path):
+    """A SIGTERM/SIGKILL mid-write leaves only a ``.ckpt.tmp`` (tmp+rename is
+    atomic): resume must ignore it — but NOT delete it, since the donor run
+    may still be alive and mid-write (forking from a live run dir is
+    supported); the age-guarded reaper in ``keep_last`` pruning removes it."""
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    save_verified_checkpoint(str(ckpt_dir / "ckpt_16_0.ckpt"), _state(16))
+    partial = ckpt_dir / "ckpt_32_0.ckpt.tmp"
+    partial.write_bytes(b"half a pickle")
+    assert resolve_resume_from(str(tmp_path)) == str(ckpt_dir / "ckpt_16_0.ckpt")
+    assert partial.exists(), "resolve must not touch tmps (live-donor hazard)"
+    # age-guarded reap leaves young tmps (a live async writer may own them)
+    assert reap_orphan_tmps(str(ckpt_dir), max_age_s=900.0) == []
+    assert partial.exists()
+    assert reap_orphan_tmps(str(ckpt_dir), max_age_s=0.0) == [str(partial)]
+    assert not partial.exists()
+
+
+def test_resolve_explicit_file_and_failure_modes(tmp_path):
+    path = str(tmp_path / "ckpt_16_0.ckpt")
+    save_verified_checkpoint(path, _state(16))
+    assert resolve_resume_from(path) == path
+    with pytest.raises(FileNotFoundError):
+        resolve_resume_from(str(tmp_path / "missing.ckpt"))
+    Path(path).write_bytes(b"\0" * os.path.getsize(path))
+    with pytest.raises(ValueError, match="digest_mismatch"):
+        resolve_resume_from(path)
+    empty = tmp_path / "empty_dir"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError, match="No verifiable checkpoint"):
+        resolve_resume_from(str(empty))
+
+
+def test_checkpoint_step_parsing():
+    assert checkpoint_step("logs/x/ckpt_512_0.ckpt") == 512
+    assert checkpoint_step("foo.ckpt", {"policy_step": 7}) == 7
+    assert checkpoint_step("foo.ckpt", {"iter_num": 3}) == 3
+    assert checkpoint_step("foo.ckpt") is None
+
+
+# ---------------------------------------------------------------------------
+# keep_last pruning (satellite 2)
+
+
+def test_keep_last_never_deletes_resume_source_or_last_verified(tmp_path):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    paths = []
+    for step in (16, 32, 48, 64):
+        p = str(ckpt_dir / f"ckpt_{step}_0.ckpt")
+        save_verified_checkpoint(p, _state(step))
+        os.utime(p, (1_000_000 + step, 1_000_000 + step))
+        os.utime(manifest_path(p), (1_000_000 + step, 1_000_000 + step))
+        paths.append(p)
+    protect_checkpoint(paths[0])
+    try:
+        callback = CheckpointCallback(keep_last=1)
+        callback._delete_old_checkpoints(ckpt_dir)
+        survivors = sorted(p.name for p in ckpt_dir.glob("*.ckpt"))
+        # keep_last=1 keeps the newest; the protected resume source survives
+        assert survivors == ["ckpt_16_0.ckpt", "ckpt_64_0.ckpt"]
+        # deleted checkpoints took their manifests with them
+        assert sorted(p.name for p in ckpt_dir.glob(f"*{MANIFEST_SUFFIX}")) == [
+            f"ckpt_16_0.ckpt{MANIFEST_SUFFIX}",
+            f"ckpt_64_0.ckpt{MANIFEST_SUFFIX}",
+        ]
+    finally:
+        PROTECTED_CHECKPOINTS.clear()
+
+
+def test_keep_last_spares_newest_verified_when_keepers_fail(tmp_path):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    good_old, good_new = str(ckpt_dir / "ckpt_16_0.ckpt"), str(ckpt_dir / "ckpt_32_0.ckpt")
+    save_verified_checkpoint(good_old, _state(16))
+    save_verified_checkpoint(good_new, _state(32))
+    # the newest file (the keeper) is truncated — its manifest no longer
+    # matches, so pruning must keep the newest VERIFIED one instead
+    bad = ckpt_dir / "ckpt_48_0.ckpt"
+    save_verified_checkpoint(str(bad), _state(48))
+    bad.write_bytes(b"trunc")
+    for i, p in enumerate((good_old, good_new, str(bad))):
+        os.utime(p, (1_000_000 + i, 1_000_000 + i))
+    CheckpointCallback(keep_last=1)._delete_old_checkpoints(ckpt_dir)
+    survivors = sorted(p.name for p in ckpt_dir.glob("*.ckpt"))
+    assert survivors == ["ckpt_32_0.ckpt", "ckpt_48_0.ckpt"]
+    # the spared one is resumable
+    assert load_state(good_new)["policy_step"] == 32
+
+
+def test_keep_last_reaps_old_orphan_tmps(tmp_path):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    for step in (16, 32):
+        save_verified_checkpoint(str(ckpt_dir / f"ckpt_{step}_0.ckpt"), _state(step))
+    stale = ckpt_dir / "ckpt_8_0.ckpt.tmp"
+    stale.write_bytes(b"interrupted long ago")
+    os.utime(stale, (1_000_000, 1_000_000))
+    fresh = ckpt_dir / "ckpt_48_0.ckpt.tmp"
+    fresh.write_bytes(b"being written right now")
+    CheckpointCallback(keep_last=5)._delete_old_checkpoints(ckpt_dir)
+    assert not stale.exists()
+    assert fresh.exists()

@@ -1,0 +1,155 @@
+"""Graceful-preemption units + the CLI drill e2e (ISSUE 13 tentpole pillar 3):
+signal guard semantics, the ``inject_preempt_iter`` chain through the real
+CLI (emergency snapshot → fsync'd ``preempted`` → exit code 75), and a
+directory resume over a planted corrupt newest checkpoint (``ckpt_skipped``
+journaled, never crashed on)."""
+
+from __future__ import annotations
+
+import os
+import signal
+from pathlib import Path
+
+import pytest
+
+from sheeprl_tpu.diagnostics import read_journal
+from sheeprl_tpu.resilience.manifest import manifest_path, verify_checkpoint
+from sheeprl_tpu.resilience.preemption import PREEMPTED_EXIT_CODE, PreemptedExit, PreemptionGuard
+
+PPO_TINY = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=1",
+    "metric.log_every=1",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.run_test=False",
+]
+
+
+def test_guard_turns_signal_into_flag_and_uninstall_restores():
+    guard = PreemptionGuard(signals=("SIGTERM",))
+    previous = signal.getsignal(signal.SIGTERM)
+    assert guard.install()
+    try:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.requested
+        assert guard.signal_name == "SIGTERM"
+    finally:
+        guard.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == previous
+
+
+def test_guard_install_refused_off_main_thread():
+    import threading
+
+    results = []
+    thread = threading.Thread(
+        target=lambda: results.append(PreemptionGuard(signals=("SIGTERM",)).install())
+    )
+    thread.start()
+    thread.join()
+    assert results == [False]
+
+
+def test_preempted_exit_carries_the_distinct_code():
+    err = PreemptedExit("drill")
+    assert isinstance(err, SystemExit)
+    assert err.code == PREEMPTED_EXIT_CODE == 75
+
+
+def test_monitor_validates_knobs():
+    from sheeprl_tpu.resilience.monitor import ResilienceMonitor
+
+    with pytest.raises(ValueError, match="max_pending_snapshots"):
+        ResilienceMonitor(
+            {"diagnostics": {"resilience": {"max_pending_snapshots": 0}}}
+        )
+    from sheeprl_tpu.cli import check_configs
+    from sheeprl_tpu.config import compose
+
+    base = ["exp=ppo", "env=dummy", "env.id=discrete_dummy"]
+    with pytest.raises(ValueError, match="max_pending_snapshots"):
+        check_configs(compose(base + ["diagnostics.resilience.max_pending_snapshots=0"]))
+    with pytest.raises(ValueError, match="inject_preempt_iter"):
+        check_configs(compose(base + ["diagnostics.resilience.inject_preempt_iter=0"]))
+    check_configs(compose(base + ["diagnostics.resilience.inject_preempt_iter=null"]))
+
+
+def test_cli_preempt_drill_then_verified_resume_over_planted_corruption(run_cli, tmp_path):
+    """Acceptance chain through the real CLI:
+
+    1. the ``inject_preempt_iter`` drill journals ``fault_injection``
+       (kind=preempt), writes the emergency snapshot through the async
+       writer (``ckpt_begin``/``ckpt_end`` land before ``run_end``),
+       journals a fsync'd ``preempted`` and exits with code 75 +
+       ``run_end`` status ``preempted``;
+    2. a *directory* resume with a planted corrupt newest checkpoint skips
+       it with a journaled ``ckpt_skipped`` reason and completes from the
+       newest verified one.
+    """
+    with pytest.raises(SystemExit) as exc_info:
+        run_cli(
+            *PPO_TINY,
+            "run_name=preempt_drill",
+            "algo.total_steps=1048576",  # far beyond what the drill allows
+            "checkpoint.every=16",
+            "diagnostics.resilience.inject_preempt_iter=3",
+        )
+    assert exc_info.value.code == PREEMPTED_EXIT_CODE
+
+    run_dir = Path("logs") / "runs" / "ppo" / "discrete_dummy" / "preempt_drill"
+    (journal_path,) = sorted(run_dir.rglob("journal.jsonl"))
+    events = read_journal(str(journal_path))
+    kinds = [e["event"] for e in events]
+    assert kinds[-1] == "run_end"
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    assert run_end["status"] == "preempted"
+
+    (fault,) = [e for e in events if e["event"] == "fault_injection"]
+    assert fault["kind"] == "preempt" and fault["iter_num"] == 3
+    (preempted,) = [e for e in events if e["event"] == "preempted"]
+    assert preempted["reason"] == "injected" and preempted["iter_num"] == 3
+    # the writer was drained BEFORE the record was written, so `preempted`
+    # never claims a snapshot that did not land
+    assert preempted["snapshot_durable"] is True
+    # the emergency snapshot is the preempt-iteration checkpoint, written
+    # through the async writer and drained before run_end
+    ends = [e for e in events if e["event"] == "ckpt_end"]
+    assert ends and all(e["status"] == "ok" and e["blocking"] is False for e in ends)
+    assert kinds.index("run_end") > kinds.index("ckpt_end")
+    emergency = preempted["path"]
+    assert verify_checkpoint(emergency, deep=True) == (True, "verified")
+
+    # ---- resume over planted corruption --------------------------------
+    ckpt_dir = Path(emergency).parent
+    steps = sorted(int(p.name.split("_")[1]) for p in ckpt_dir.glob("*.ckpt"))
+    planted = ckpt_dir / f"ckpt_{steps[-1] + 16}_0.ckpt"
+    planted.write_bytes(b"corrupt planted newest")
+    run_cli(
+        *PPO_TINY,
+        "run_name=preempt_drill",
+        "dry_run=True",
+        f"checkpoint.resume_from={run_dir}",
+    )
+    journals = sorted(run_dir.rglob("journal.jsonl"))
+    assert len(journals) == 2
+    resumed = read_journal(str(journals[-1]))
+    (skip,) = [e for e in resumed if e["event"] == "ckpt_skipped"]
+    assert skip["path"] == str(planted) and skip["reason"].startswith("unreadable")
+    assert resumed[-1]["event"] == "run_end" and resumed[-1]["status"] == "completed"
+    # the planted file is still there (selection skips, never deletes) and
+    # still has no manifest
+    assert planted.exists() and not os.path.exists(manifest_path(str(planted)))

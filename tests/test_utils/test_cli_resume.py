@@ -9,6 +9,7 @@ import yaml
 
 from sheeprl_tpu.cli import resume_from_checkpoint
 from sheeprl_tpu.config import compose
+from sheeprl_tpu.resilience.manifest import save_verified_checkpoint
 
 import pytest
 
@@ -37,7 +38,9 @@ def _archive_run(tmp_path):
     with open(version / "config.yaml", "w") as fp:
         yaml.safe_dump(cfg.as_dict(), fp)
     ckpt = version / "checkpoint" / "ckpt_16_0.ckpt"
-    ckpt.write_bytes(b"")
+    # a real (tiny) checkpoint: resume selection verifies the file now
+    # (ISSUE 13) — an empty placeholder would be rejected as `empty`
+    save_verified_checkpoint(str(ckpt), {"policy_step": 16})
     return cfg, ckpt
 
 

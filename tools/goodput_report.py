@@ -17,7 +17,11 @@ journal.  This tool groups those siblings into ONE logical run and reports:
 * time-to-recover between consecutive segments (end of the killed journal →
   first event of the resumed one) — ROADMAP item 4's headline number;
 * whole-run totals: wall (first event → last event across segments, i.e.
-  including the recovery gaps), productive, stalled, and overall goodput.
+  including the recovery gaps), productive, stalled, and overall goodput;
+* when the run was driven by ``tools/supervise.py``, the supervisor's own
+  ``<run dir>/supervisor.jsonl`` restart journal: restart count and the
+  *measured* child-exit→respawn downtime per kill/resume cycle — real
+  numbers, not inferred from segment gaps.
 
 Usage:
     python tools/goodput_report.py logs/runs/ppo/CartPole-v1/<run_name>/
@@ -42,6 +46,32 @@ from sheeprl_tpu.diagnostics.journal import collect_journals, read_journal  # no
 from sheeprl_tpu.diagnostics.report import goodput_status_lines  # noqa: E402
 
 _VERSION_RE = re.compile(r"^version_(\d+)$")
+
+SUPERVISOR_JOURNAL = "supervisor.jsonl"
+
+
+def read_supervisor(run_dir: str) -> Optional[Dict[str, Any]]:
+    """Restart accounting from the supervisor's own journal (None when the
+    run was not supervised).  ``measured_down_s`` sums the supervisor's
+    child-exit→respawn gaps — the directly measured half of time-to-recover
+    (the resumed child's setup/compile time shows up in the segment gaps)."""
+    path = os.path.join(run_dir, SUPERVISOR_JOURNAL)
+    if not os.path.isfile(path):
+        return None
+    events = read_journal(path)
+    restarts = [e for e in events if e.get("event") == "restart" and not e.get("gave_up")]
+    gave_up = any(e.get("gave_up") for e in events if e.get("event") == "restart")
+    downs = [e.get("down_s") for e in restarts if isinstance(e.get("down_s"), (int, float))]
+    return {
+        "restarts": len(restarts),
+        "gave_up": gave_up,
+        "measured_down_s": round(sum(downs), 3) if downs else None,
+        "preempted_restarts": sum(1 for e in restarts if e.get("preempted")),
+        "events": [
+            {k: e.get(k) for k in ("t", "attempt", "rc", "preempted", "backoff_s", "down_s", "resume_from")}
+            for e in restarts
+        ],
+    }
 
 #: A run_end-less journal younger than this is "probably still running" —
 #: applied to the NEWEST segment only; an older run_end-less segment is
@@ -177,6 +207,16 @@ def format_run(
     if analysis["recovered_train_s"]:
         total += f" · {analysis['recovered_train_s']:.1f}s productive recovered from killed segments"
     lines.append(total)
+    supervisor = analysis.get("supervisor")
+    if supervisor:
+        line = f"  supervisor: {supervisor['restarts']} restart(s)"
+        if supervisor.get("preempted_restarts"):
+            line += f" ({supervisor['preempted_restarts']} preempted)"
+        if supervisor.get("measured_down_s") is not None:
+            line += f" · measured downtime {supervisor['measured_down_s']:.1f}s (restart journal)"
+        if supervisor.get("gave_up"):
+            line += " · GAVE UP (restart budget exhausted)"
+        lines.append(line)
     # the newest segment's status panel, banner suppressed: this is a
     # post-mortem view, not a live dashboard (run_monitor keeps the banner)
     newest = segments[-1] if segments else None
@@ -202,17 +242,21 @@ def main() -> int:
         return 2
     runs = group_segment_journals(journals)
     if args.json:
-        print(
-            json.dumps(
-                {run_dir: analyze_segments(paths) for run_dir, paths in runs}, indent=2
-            )
-        )
+        out = {}
+        for run_dir, paths in runs:
+            analysis = analyze_segments(paths)
+            if os.path.isdir(run_dir):
+                analysis["supervisor"] = read_supervisor(run_dir)
+            out[run_dir] = analysis
+        print(json.dumps(out, indent=2))
         return 0
     for i, (run_dir, paths) in enumerate(runs):
         if i:
             print()
         newest_events: List[Dict[str, Any]] = []
         analysis = analyze_segments(paths, newest_events=newest_events)
+        if os.path.isdir(run_dir):
+            analysis["supervisor"] = read_supervisor(run_dir)
         print(format_run(run_dir, analysis, newest_events=newest_events))
     return 0
 

@@ -43,7 +43,9 @@ DIAG_PREFIX = "sheeprl_tpu/diagnostics/"
 DOC_PATH = "howto/diagnostics.md"
 TABLE_BEGIN = "<!-- lint:event-table:begin -->"
 TABLE_END = "<!-- lint:event-table:end -->"
-EMITTER_METHODS = {"_journal", "_journal_event", "_journal_synced"}
+# queue_journal_event: the resilience layer's deferred emission — events
+# queued before the run journal exists are journaled verbatim at open
+EMITTER_METHODS = {"_journal", "_journal_event", "_journal_synced", "queue_journal_event"}
 TELEMETRY_GAUGE_RE = re.compile(r"^Telemetry/[A-Za-z0-9_]+(/[A-Za-z0-9_]+)*$")
 METRIC_PREFIX = "sheeprl_"
 

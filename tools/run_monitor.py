@@ -51,7 +51,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from sheeprl_tpu.diagnostics.goodput import STATES  # noqa: E402
 from sheeprl_tpu.diagnostics.journal import find_journal  # noqa: E402
-from sheeprl_tpu.diagnostics.report import format_bytes, format_event_line, status_block  # noqa: E402
+from sheeprl_tpu.diagnostics.report import (  # noqa: E402
+    format_bytes,
+    format_event_line,
+    no_recent_ckpt_banner,
+    status_block,
+)
 
 _PROM_LINE = re.compile(r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?P<labels>\{[^}]*\})?\s+(?P<value>\S+)$")
 
@@ -187,6 +192,21 @@ def endpoint_status(url: str) -> str:
         if serve_counters:
             lines.append("totals  " + " · ".join(serve_counters))
         return "\n".join(lines)
+    ckpt_step = metrics.get("sheeprl_ckpt_last_step")
+    ckpt_age = metrics.get("sheeprl_ckpt_age_seconds")
+    ckpt_interval = metrics.get("sheeprl_ckpt_interval_seconds")
+    if ckpt_step is not None or ckpt_age is not None:
+        ckpt_parts = []
+        if ckpt_step is not None:
+            ckpt_parts.append(f"last step {ckpt_step:g}")
+        if ckpt_age is not None:
+            ckpt_parts.append(f"age {ckpt_age:.0f}s")
+        if ckpt_interval is not None:
+            ckpt_parts.append(f"every ~{ckpt_interval:.0f}s")
+        lines.append("ckpts   " + " · ".join(ckpt_parts))
+        banner = no_recent_ckpt_banner(ckpt_age, ckpt_interval)
+        if banner is not None:
+            lines.append(banner)
     active_anomalies = metrics.get("sheeprl_health_anomalies")
     if active_anomalies:
         info = metrics["_labels"].get("sheeprl_run_info") or []
@@ -263,6 +283,9 @@ def endpoint_status(url: str) -> str:
         ("sheeprl_donation_miss_leaves_total", "donation-miss leaves"),
         ("sheeprl_oom_events_total", "ooms"),
         ("sheeprl_health_anomalies_total", "health anomalies"),
+        ("sheeprl_ckpts_written_total", "ckpts written"),
+        ("sheeprl_ckpt_failures_total", "ckpt failures"),
+        ("sheeprl_restarts_total", "restarts"),
     ):
         value = metrics.get(key)
         if value is not None:

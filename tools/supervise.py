@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Auto-restart supervisor for training runs (ROADMAP item 4 elasticity).
+
+Thin launcher over ``sheeprl_tpu.resilience.supervisor`` (same flags),
+runnable straight from a checkout:
+
+    python tools/supervise.py --max-restarts 5 -- \
+        exp=dreamer_v3 env=atari run_name=prod_run checkpoint.every=5000
+
+The supervisor restarts the run on any non-clean exit with capped
+exponential backoff (graceful preemptions — exit code 75 — respawn
+immediately), resumes from the newest checkpoint whose manifest verifies,
+and journals ``restart`` events to ``<run dir>/supervisor.jsonl`` so
+``tools/goodput_report.py`` reports measured time-to-recover.
+
+See ``howto/resilience.md`` for the full kill-to-recovered lifecycle.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# runnable straight from a checkout: tools/ is not a package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.resilience.supervisor import main  # noqa: E402
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
