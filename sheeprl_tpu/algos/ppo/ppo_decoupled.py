@@ -288,37 +288,75 @@ def main(runtime, cfg):
             )
 
         # ---- TRAINERS: update epochs on the sub-mesh ----------------------
-        with timer("Time/train_time"), diag.span("train", role="trainer"):
-            rng_key, train_key = jax.random.split(rng_key)
-            coefs = (
-                jnp.asarray(clip_coef, jnp.float32),
-                jnp.asarray(ent_coef, jnp.float32),
-                jnp.asarray(cfg.algo.vf_coef, jnp.float32),
-            )
-            trainer_params, opt_state, losses, health = train_step(
-                trainer_params, opt_state, device_data, train_key, coefs
-            )
-            # one blocking d2h for metrics + health stats together
-            losses, health_host = fetch_values(losses, health)
+        # quarantined: a chaos-injected (or real) dispatch failure rolls the
+        # trainer back to the last-good snapshot instead of killing the run
+        # (bounded by resilience.isolation.retry_budget; howto/resilience.md)
+        trained_ok = True
+        try:
+            with timer("Time/train_time"), diag.span("train", role="trainer"):
+                diag.maybe_chaos_trainer_fault(iter_num)
+                rng_key, train_key = jax.random.split(rng_key)
+                coefs = (
+                    jnp.asarray(clip_coef, jnp.float32),
+                    jnp.asarray(ent_coef, jnp.float32),
+                    jnp.asarray(cfg.algo.vf_coef, jnp.float32),
+                )
+                trainer_params, opt_state, losses, health = train_step(
+                    trainer_params, opt_state, device_data, train_key, coefs
+                )
+                # one blocking d2h for metrics + health stats together
+                losses, health_host = fetch_values(losses, health)
+        except Exception as err:
+            restored = diag.quarantine(err, iter_num, policy_step_count)
+            if restored is None:
+                raise
+            # the dispatch may have consumed (donated) the live buffers; the
+            # restore re-materializes both trees from the host snapshot.  No
+            # metrics exist for this iteration, but the loop still falls
+            # through to the preemption/checkpoint boundary below.
+            trainer_params = jax.device_put(restored["params"], trainer_repl)
+            opt_state = jax.device_put(restored["opt_state"], trainer_repl)
+            trained_ok = False
 
-        # ---- params broadcast back to the player (reference :302-305) -----
-        player_params = jax.device_put(trainer_params, player_device)
+        if trained_ok:
+            # ---- last-good fencing: the params hop to the player only
+            # happens when the update judges healthy (in-graph nonfinite
+            # count + fetched health norms + open anomalies — no extra device
+            # syncs); a rejected update leaves the player acting on its
+            # last-good params
+            if diag.gate_promotion(
+                iter_num, policy_step_count, stats=health_host, nonfinite=float(losses[4])
+            ):
+                # ---- params broadcast back to the player (reference :302-305)
+                player_params = jax.device_put(trainer_params, player_device)
+                diag.refresh_last_good(iter_num, trainer_params, opt_state)
 
-        diag.on_health(policy_step_count, health_host)
-        aggregator.update("Loss/policy_loss", float(losses[0]))
-        aggregator.update("Loss/value_loss", float(losses[1]))
-        aggregator.update("Loss/entropy_loss", float(losses[2]))
-        aggregator.update("Grads/global_norm", float(losses[3]))
-        diag.on_update(
-            policy_step_count,
-            {
-                "Loss/policy_loss": float(losses[0]),
-                "Loss/value_loss": float(losses[1]),
-                "Loss/entropy_loss": float(losses[2]),
-                "Grads/global_norm": float(losses[3]),
-            },
-            nonfinite=float(losses[4]),
-        )
+            diag.on_health(policy_step_count, health_host)
+            aggregator.update("Loss/policy_loss", float(losses[0]))
+            aggregator.update("Loss/value_loss", float(losses[1]))
+            aggregator.update("Loss/entropy_loss", float(losses[2]))
+            aggregator.update("Grads/global_norm", float(losses[3]))
+            try:
+                diag.on_update(
+                    policy_step_count,
+                    {
+                        "Loss/policy_loss": float(losses[0]),
+                        "Loss/value_loss": float(losses[1]),
+                        "Loss/entropy_loss": float(losses[2]),
+                        "Grads/global_norm": float(losses[3]),
+                    },
+                    nonfinite=float(losses[4]),
+                )
+            except Exception as err:
+                # sentinel policy=halt on a fenced update: roll the trainer
+                # back to the last-good snapshot and keep the run alive (the
+                # player never saw the bad params — the gate already held
+                # them)
+                restored = diag.quarantine(err, iter_num, policy_step_count)
+                if restored is None:
+                    raise
+                trainer_params = jax.device_put(restored["params"], trainer_repl)
+                opt_state = jax.device_put(restored["opt_state"], trainer_repl)
 
         if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
             metrics = aggregator.compute()
@@ -337,32 +375,53 @@ def main(runtime, cfg):
             timer.reset()
             last_log = policy_step_count
 
-        # a pending preemption (signal or drill) forces the branch: the save
-        # below IS the emergency snapshot (howto/resilience.md)
+        # a pending preemption (signal or drill) or an exhausted staleness
+        # budget forces the branch: the save below IS the emergency snapshot
+        # (howto/resilience.md)
         preempt_now = diag.preempt_due(iter_num)
+        fence_halt_now = diag.fence_halt_due()
         if (
             (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
             or cfg.dry_run
             or preempt_now
+            or fence_halt_now
             or (iter_num == total_iters and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step_count
+            agent_save = jax.tree_util.tree_map(np.asarray, trainer_params)
+            opt_save = jax.tree_util.tree_map(np.asarray, opt_state)
+            ckpt_iter, ckpt_step = iter_num, policy_step_count
+            if fence_halt_now:
+                # the fence escalated BECAUSE the live trainer state is bad
+                # (under policy=warn the NaN update was applied): the
+                # emergency snapshot must be the last-good state, not the
+                # corruption it is escaping — with the counters (and hence
+                # the file/manifest step) of the iteration it came FROM, so
+                # a resume never claims progress that never happened
+                last_good = diag.last_good_state()
+                if last_good is not None:
+                    agent_save, opt_save = last_good["params"], last_good["opt_state"]
+                    ckpt_iter = last_good["iter_num"]
+                    ckpt_step = ckpt_iter * policy_steps_per_iter
             ckpt_state = {
-                "agent": jax.tree_util.tree_map(np.asarray, trainer_params),
-                "opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
-                "iter_num": iter_num,
-                "policy_step": policy_step_count,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
+                "agent": agent_save,
+                "opt_state": opt_save,
+                "iter_num": ckpt_iter,
+                "policy_step": ckpt_step,
+                "last_log": min(last_log, ckpt_step),
+                "last_checkpoint": min(last_checkpoint, ckpt_step),
                 "batch_size": batch_size * n_trainers,
             }
-            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{ckpt_step}_0.ckpt")
             with diag.span("checkpoint"):
                 runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state, replay_buffer=None)
             diag.on_checkpoint(policy_step_count, ckpt_path)
             if preempt_now:
                 envs.close()
                 diag.on_preempted(policy_step_count, iter_num, ckpt_path)
+            if fence_halt_now:
+                envs.close()
+                diag.on_fence_halt(policy_step_count, iter_num, ckpt_path)
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:

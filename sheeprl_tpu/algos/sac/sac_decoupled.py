@@ -159,6 +159,13 @@ def main(runtime, cfg):
     batch_size = cfg.algo.per_rank_batch_size
     obs, _ = envs.reset(seed=cfg.seed)
 
+    def restore_last_good(restored) -> None:
+        """Re-materialize the trainer's params + opt state from the last-good
+        host snapshot (the dispatch may have consumed the donated buffers)."""
+        nonlocal params, opt_states
+        params = jax.device_put(restored["params"], trainer_repl)
+        opt_states = jax.device_put(restored["opt_state"], trainer_repl)
+
     def run_train(iter_num: int, per_rank_gradient_steps: int) -> None:
         """Sample + dispatch this iteration's gradient steps on the trainer
         sub-mesh and fetch the metrics (the blocking fetch included, so the
@@ -178,29 +185,55 @@ def main(runtime, cfg):
                     if k in ("observations", "next_observations", "actions", "rewards", "terminated")
                 }
             data = diag.maybe_inject_nan(iter_num, data)
-            with diag.span("train", role="trainer"):
-                rng_key, scan_key = jax.random.split(rng_key)
-                keys = jax.random.split(scan_key, per_rank_gradient_steps)
-                params, opt_states, losses, health = train_step(params, opt_states, data, keys)
-                # one blocking d2h for metrics + health stats together
-                losses, health_host = fetch_values(losses, health)
-        # actor params broadcast back to the player (reference :550-554)
-        player_actor_params = jax.device_put(params["actor"], player_device)
+            # quarantined — the TRAIN DISPATCH only, like ppo_decoupled: a
+            # sampling/staging failure is not a train-step incident and must
+            # not burn the rollback budget (resilience.isolation.retry_budget)
+            try:
+                with diag.span("train", role="trainer"):
+                    diag.maybe_chaos_trainer_fault(iter_num)
+                    rng_key, scan_key = jax.random.split(rng_key)
+                    keys = jax.random.split(scan_key, per_rank_gradient_steps)
+                    params, opt_states, losses, health = train_step(params, opt_states, data, keys)
+                    # one blocking d2h for metrics + health stats together
+                    losses, health_host = fetch_values(losses, health)
+            except Exception as err:
+                restored = diag.quarantine(err, iter_num, policy_step_count)
+                if restored is None:
+                    raise
+                restore_last_good(restored)
+                return
+        # last-good fencing: the actor-params hop to the player only happens
+        # when the update judges healthy; a rejected update leaves the player
+        # acting on its last-good actor params (reference :550-554)
+        if diag.gate_promotion(
+            iter_num, policy_step_count, stats=health_host, nonfinite=float(losses[4])
+        ):
+            player_actor_params = jax.device_put(params["actor"], player_device)
+            diag.refresh_last_good(iter_num, params, opt_states)
         diag.on_health(policy_step_count, health_host)
         aggregator.update("Loss/value_loss", float(losses[0]))
         aggregator.update("Loss/policy_loss", float(losses[1]))
         aggregator.update("Loss/alpha_loss", float(losses[2]))
         aggregator.update("Grads/global_norm", float(losses[3]))
-        diag.on_update(
-            policy_step_count,
-            {
-                "Loss/value_loss": float(losses[0]),
-                "Loss/policy_loss": float(losses[1]),
-                "Loss/alpha_loss": float(losses[2]),
-                "Grads/global_norm": float(losses[3]),
-            },
-            nonfinite=float(losses[4]),
-        )
+        try:
+            diag.on_update(
+                policy_step_count,
+                {
+                    "Loss/value_loss": float(losses[0]),
+                    "Loss/policy_loss": float(losses[1]),
+                    "Loss/alpha_loss": float(losses[2]),
+                    "Grads/global_norm": float(losses[3]),
+                },
+                nonfinite=float(losses[4]),
+            )
+        except Exception as err:
+            # sentinel policy=halt on a fenced update: roll the trainer back
+            # and keep the run alive (the gate above already held the bad
+            # params away from the player)
+            restored = diag.quarantine(err, iter_num, policy_step_count)
+            if restored is None:
+                raise
+            restore_last_good(restored)
 
     for iter_num in range(start_iter, total_iters + 1):
         policy_step_count += policy_steps_per_iter
@@ -283,27 +316,43 @@ def main(runtime, cfg):
             timer.reset()
             last_log = policy_step_count
 
-        # a pending preemption (signal or drill) forces the branch: the save
-        # below IS the emergency snapshot (howto/resilience.md)
+        # a pending preemption (signal or drill) or an exhausted staleness
+        # budget forces the branch: the save below IS the emergency snapshot
+        # (howto/resilience.md)
         preempt_now = diag.preempt_due(iter_num)
+        fence_halt_now = diag.fence_halt_due()
         if (
             (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
             or cfg.dry_run
             or preempt_now
+            or fence_halt_now
             or (iter_num == total_iters and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step_count
+            agent_save = jax.tree_util.tree_map(np.asarray, params)
+            opt_save = jax.tree_util.tree_map(np.asarray, opt_states)
+            ckpt_iter, ckpt_step = iter_num, policy_step_count
+            if fence_halt_now:
+                # the fence escalated BECAUSE the live trainer state is bad:
+                # the emergency snapshot must be the last-good state, not the
+                # corruption it is escaping — with the counters (and the
+                # file/manifest step) of the iteration it came FROM
+                last_good = diag.last_good_state()
+                if last_good is not None:
+                    agent_save, opt_save = last_good["params"], last_good["opt_state"]
+                    ckpt_iter = last_good["iter_num"]
+                    ckpt_step = ckpt_iter * policy_steps_per_iter
             ckpt_state = {
-                "agent": jax.tree_util.tree_map(np.asarray, params),
-                "opt_states": jax.tree_util.tree_map(np.asarray, opt_states),
+                "agent": agent_save,
+                "opt_states": opt_save,
                 "ratio": ratio.state_dict(),
-                "iter_num": iter_num,
-                "policy_step": policy_step_count,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
+                "iter_num": ckpt_iter,
+                "policy_step": ckpt_step,
+                "last_log": min(last_log, ckpt_step),
+                "last_checkpoint": min(last_checkpoint, ckpt_step),
                 "batch_size": batch_size * n_trainers,
             }
-            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step_count}_0.ckpt")
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{ckpt_step}_0.ckpt")
             with diag.span("checkpoint"):
                 runtime.call(
                     "on_checkpoint_player",
@@ -315,6 +364,9 @@ def main(runtime, cfg):
             if preempt_now:
                 envs.close()
                 diag.on_preempted(policy_step_count, iter_num, ckpt_path)
+            if fence_halt_now:
+                envs.close()
+                diag.on_fence_halt(policy_step_count, iter_num, ckpt_path)
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:

@@ -192,6 +192,34 @@ def check_configs(cfg: dotdict) -> None:
             f"diagnostics.resilience.inject_preempt_iter must be >= 1 (1 = first "
             f"iteration) or null, got {inject_preempt!r}"
         )
+    # fault-isolation / chaos knobs: validated here AND in their monitor
+    # ctors (direct entrypoint callers skip check_configs) so a bad budget or
+    # schedule fails before the run dir exists
+    iso_cfg = res_cfg.get("isolation") or {}
+    max_staleness = iso_cfg.get("max_staleness")
+    if max_staleness is not None and int(max_staleness) < 1:
+        raise ValueError(
+            f"diagnostics.resilience.isolation.max_staleness must be >= 1, got {max_staleness!r}"
+        )
+    retry_budget = iso_cfg.get("retry_budget")
+    if retry_budget is not None and int(retry_budget) < 0:
+        raise ValueError(
+            f"diagnostics.resilience.isolation.retry_budget must be >= 0, got {retry_budget!r}"
+        )
+    refresh_every = iso_cfg.get("refresh_every")
+    if refresh_every is not None and int(refresh_every) < 1:
+        raise ValueError(
+            f"diagnostics.resilience.isolation.refresh_every must be >= 1, got {refresh_every!r}"
+        )
+    chaos_cfg = res_cfg.get("chaos") or {}
+    from sheeprl_tpu.resilience.chaos import parse_schedule
+
+    parse_schedule(chaos_cfg.get("schedule"))  # raises ValueError on a bad entry
+    slow_write_s = chaos_cfg.get("slow_write_s")
+    if slow_write_s is not None and float(slow_write_s) <= 0:
+        raise ValueError(
+            f"diagnostics.resilience.chaos.slow_write_s must be > 0, got {slow_write_s!r}"
+        )
     # learning-health knobs: validated here AND in the HealthMonitor ctor
     # (direct entrypoint callers skip check_configs) so a bad band/window
     # fails before the run dir exists

@@ -508,6 +508,8 @@ class Diagnostics:
             extra.update(self.goodput.interval_metrics())
         if self.health is not None:
             extra.update(self.health.interval_metrics())
+        if self.resilience is not None and self._rank_zero:
+            extra.update(self.resilience.interval_metrics())
         if not extra:
             return metrics
         merged = dict(metrics)
@@ -563,15 +565,116 @@ class Diagnostics:
         self.tracer.instant("checkpoint", step=step)
 
     # -- resilience hooks (ISSUE 13) ----------------------------------------
-    def save_checkpoint(self, path: str, state: Mapping[str, Any]) -> bool:
+    def save_checkpoint(self, path: str, state: Mapping[str, Any], group: Optional[Mapping[str, Any]] = None) -> bool:
         """Route one checkpoint save through the resilience layer (async
         writer or blocking-with-journaling, manifest sidecar either way).
         Returns False when the layer is off/unopened — the caller
-        (``Runtime.save``) then performs the plain synchronous save itself."""
+        (``Runtime.save``) then performs the plain synchronous save itself.
+        ``group`` threads the coordinated multi-host record into the
+        manifest (``resilience/coordination.py``)."""
         if self.resilience is None or not self.resilience._opened:
             return False
-        self.resilience.save(path, state)
+        self.resilience.save(path, state, group=group)
         return True
+
+    # -- fault isolation hooks (ISSUE 14: decoupled fencing & rollback) ------
+    def gate_promotion(
+        self,
+        iter_num: int,
+        step: Optional[int],
+        stats: Optional[Mapping[str, Any]] = None,
+        nonfinite: float = 0.0,
+    ) -> bool:
+        """Promotion gate for the trainer→player params hop: True = hand the
+        freshly trained params to the player.  Judges the signals the loop
+        ALREADY fetched (in-graph nonfinite count, ``health_stats`` norms)
+        plus any open learning-health anomaly — zero extra device syncs.  A
+        rejection journals ``params_reject`` and the player keeps its
+        last-good params.  Always True when isolation is off (today's
+        unconditional hand-off)."""
+        res = self.resilience
+        if res is None or res.isolation is None or not res._opened:
+            return True
+        anomalies = ()
+        if self.health is not None and self.health._opened:
+            anomalies = self.health.open_anomaly_kinds()
+        return res.isolation.judge(iter_num, step, stats or {}, float(nonfinite), anomalies)
+
+    def refresh_last_good(self, iter_num: int, params: Any, opt_state: Any) -> None:
+        """Refresh the in-memory last-good snapshot after a healthy
+        promotion (one batched device→host fetch, double-buffered)."""
+        res = self.resilience
+        if res is not None and res.isolation is not None and res._opened:
+            res.isolation.refresh(iter_num, params, opt_state)
+
+    def quarantine(
+        self, err: BaseException, iter_num: int, step: Optional[int]
+    ) -> Optional[Dict[str, Any]]:
+        """Absorb one quarantined train-step failure: journal ``rollback``
+        and return the last-good ``{params, opt_state, iter_num}`` snapshot
+        for the loop to restore, or None (no snapshot / isolation off /
+        retry budget spent) — the caller then re-raises."""
+        res = self.resilience
+        if res is None or res.isolation is None or not res._opened:
+            return None
+        return res.isolation.rollback(err, iter_num, step)
+
+    def last_good_state(self) -> Optional[Dict[str, Any]]:
+        """The in-memory last-good ``{params, opt_state, iter_num}`` host
+        snapshot, or None.  The fence-halt checkpoint branch saves THIS, not
+        the live trainer trees — under ``sentinel.policy=warn`` the live
+        params are exactly the corrupted state the fence escalated about."""
+        res = self.resilience
+        if res is None or res.isolation is None or not res._opened:
+            return None
+        return res.isolation.last_good
+
+    def fence_halt_due(self) -> bool:
+        """True once the staleness budget is exhausted: the loop forces its
+        checkpoint branch (emergency snapshot of the last-good state) and
+        then calls :meth:`on_fence_halt`."""
+        res = self.resilience
+        return res is not None and res.isolation is not None and res._opened and res.isolation.halt_due
+
+    def on_fence_halt(self, step: Optional[int], iter_num: int, ckpt_path: str) -> None:
+        """Finish a staleness escalation: journal the structured finding
+        (fsync'd), close the run with status ``halted`` and raise
+        :class:`~sheeprl_tpu.resilience.isolation.IsolationHalt`."""
+        from sheeprl_tpu.resilience.isolation import IsolationHalt
+
+        iso = self.resilience.isolation
+        self._journal_divergence(
+            {
+                "kind": "param_staleness_exhausted",
+                "step": step,
+                "iter_num": int(iter_num),
+                "staleness": iso.staleness,
+                "budget": iso.max_staleness,
+                "path": str(ckpt_path),
+            }
+        )
+        self._journal_sync()
+        self.close("halted")
+        raise IsolationHalt(
+            f"player param staleness exhausted its budget ({iso.staleness} > "
+            f"{iso.max_staleness} consecutive rejected promotions) at iteration {iter_num}; "
+            f"emergency checkpoint {ckpt_path} "
+            "(diagnostics.resilience.isolation.max_staleness)"
+        )
+
+    def maybe_chaos_trainer_fault(self, iter_num: int) -> None:
+        """Raise the scheduled :class:`ChaosTrainerError` at the train
+        dispatch boundary (chaos fault ``trainer_exception``); no-op
+        otherwise."""
+        res = self.resilience
+        if res is None or res.chaos is None or not res._opened:
+            return
+        if res.chaos.take(iter_num, "trainer_exception"):
+            from sheeprl_tpu.resilience.chaos import ChaosTrainerError
+
+            raise ChaosTrainerError(
+                f"chaos: injected trainer exception at iteration {iter_num}"
+            )
 
     def preempt_due(self, iter_num: int) -> bool:
         """True once a preemption (SIGTERM/SIGINT, or the
@@ -645,7 +748,16 @@ class Diagnostics:
             }
         )
         if self.sentinel.policy == "halt":
-            self.close("halted")
+            # a decoupled loop with the isolation layer armed catches this
+            # halt and rolls back to the last-good snapshot — closing the
+            # facade here would kill the journal under a run that survives
+            absorbable = (
+                self.resilience is not None
+                and self.resilience.isolation is not None
+                and self.resilience.isolation.can_absorb()
+            )
+            if not absorbable:
+                self.close("halted")
             raise SentinelHalt(
                 f"non-finite training update at step {step} "
                 f"(nonfinite optimizer steps this interval: {nonfinite:g}); "
@@ -679,13 +791,21 @@ class Diagnostics:
     # -- fault injection (tests / chaos drills) ----------------------------
     def maybe_inject_nan(self, iter_num: int, tree):
         """Poison a train batch at the configured iteration
-        (``diagnostics.sentinel.inject_nan_iter``) — the documented way to
-        drill the sentinel path end-to-end without doctoring model code."""
+        (``diagnostics.sentinel.inject_nan_iter``, or a chaos schedule's
+        ``nan_grads`` entry) — the documented way to drill the sentinel /
+        fencing paths end-to-end without doctoring model code."""
+        poison = False
+        res = self.resilience
+        if res is not None and res.chaos is not None and res._opened:
+            # take() journals its own fault_injection (kind=nan_grads)
+            poison = res.chaos.take(iter_num, "nan_grads")
         inject = self.sentinel.inject_nan_iter
-        if inject is None or int(iter_num) != inject:
+        if inject is not None and int(iter_num) == inject:
+            if self.journal is not None:
+                self.journal.write("fault_injection", iter_num=int(iter_num))
+            poison = True
+        if not poison:
             return tree
-        if self.journal is not None:
-            self.journal.write("fault_injection", iter_num=int(iter_num))
         return poison_tree(tree)
 
     def maybe_inject_shape_change(self, iter_num: int, tree, pad: int = 1):

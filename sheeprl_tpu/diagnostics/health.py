@@ -529,6 +529,15 @@ class HealthMonitor:
                         spread=round(spread, 8) if full else None,
                     )
 
+    def open_anomaly_kinds(self) -> List[str]:
+        """Sorted kinds of the currently-active anomalies (the decoupled
+        promotion gate's "open sentinel anomaly" veto signal — cheap enough
+        to consult once per trainer iteration)."""
+        if not self._opened:
+            return []
+        with self._lock:
+            return sorted({kind for kind, _subject in self._active})
+
     # -- gauges / snapshots --------------------------------------------------
     def interval_metrics(self) -> Dict[str, float]:
         """The ``Telemetry/health/*`` gauges merged into every metric

@@ -249,6 +249,18 @@ def format_event_line(event: Dict[str, Any]) -> str:
         )
     if kind == "ckpt_skipped":
         return f"[{clock}] {kind:<12s} {payload.get('path')}: {payload.get('reason')}"
+    if kind == "params_reject":
+        mark = "!! PARAMS-REJ" if payload.get("escalate") else kind
+        return (
+            f"[{clock}] {mark:<12s} {payload.get('reason')} at iter {payload.get('iter_num')} "
+            f"(staleness {payload.get('staleness')}/{payload.get('budget')}; player on last-good params)"
+        )
+    if kind == "rollback":
+        return (
+            f"[{clock}] {'!! ROLLBACK':<12s} restored iter-{payload.get('restored_iter')} snapshot at iter "
+            f"{payload.get('iter_num')} ({payload.get('retries_left')}/{payload.get('budget')} retries left): "
+            f"{str(payload.get('error', ''))[:60]}"
+        )
     if kind == "preempted":
         return (
             f"[{clock}] {'!! PREEMPT':<12s} {payload.get('reason')} at iter "
@@ -318,6 +330,7 @@ def status_block(events: List[Dict[str, Any]]) -> str:
                  f"{n_ckpt} checkpoints · {n_rec} recompiles · {n_div} divergences")
     lines.extend(goodput_status_lines(events, live=run_end is None))
     lines.extend(checkpoint_status_lines(events, live=run_end is None))
+    lines.extend(isolation_status_lines(events, live=run_end is None))
     lines.extend(health_status_lines(events, live=run_end is None))
     lines.extend(memory_status_lines(events))
     return "\n".join(lines)
@@ -416,6 +429,54 @@ def checkpoint_status_lines(events: List[Dict[str, Any]], live: bool = True) -> 
             )
             cadence = _median([b - a for a, b in zip(mt, mt[1:])])
         banner = no_recent_ckpt_banner(age, cadence)
+        if banner is not None:
+            lines.append(banner)
+    return lines
+
+
+def stale_params_banner(staleness: Any, budget: Any) -> Optional[str]:
+    """The ``!! STALE-PARAMS`` banner line (or None): ONE owner for the
+    threshold/wording so run_monitor's journal and endpoint modes can never
+    drift.  Fires once the decoupled player has been fenced off fresh
+    trainer params for more than HALF the staleness budget — the window in
+    which escalation (emergency snapshot + halt) is approaching."""
+    if not isinstance(staleness, (int, float)) or not isinstance(budget, (int, float)):
+        return None
+    if budget <= 0 or staleness <= budget / 2.0:
+        return None
+    return (
+        f"!! STALE-PARAMS — player is {staleness:.0f} trainer updates behind "
+        f"(budget {budget:.0f}); the fence halts the run when the budget is exhausted"
+    )
+
+
+def isolation_status_lines(events: List[Dict[str, Any]], live: bool = True) -> List[str]:
+    """The param-staleness / rollback panel (run_monitor + journal_report
+    share it): reject/rollback counters, the latest staleness gauge, and —
+    live mode only — the ``!! STALE-PARAMS`` banner past half the budget.
+    Empty when the run journaled no fencing activity (coupled runs, and
+    decoupled runs that never rejected)."""
+    rejects = [e for e in events if e.get("event") == "params_reject"]
+    rollbacks = [e for e in events if e.get("event") == "rollback"]
+    metrics_events = [e for e in events if e.get("event") == "metrics"]
+    last = (metrics_events[-1].get("metrics") or {}) if metrics_events else {}
+    staleness = last.get("Telemetry/param_staleness")
+    if not rejects and not rollbacks and not isinstance(staleness, (int, float)):
+        return []
+    parts = [f"{len(rejects)} rejects", f"{len(rollbacks)} rollbacks"]
+    if isinstance(staleness, (int, float)):
+        parts.append(f"staleness {staleness:.0f}")
+    if rejects:
+        newest = rejects[-1]
+        parts.append(f"last reject: {newest.get('reason')} at iter {newest.get('iter_num')}")
+    if rollbacks:
+        retries_left = rollbacks[-1].get("retries_left")
+        if retries_left is not None:
+            parts.append(f"{retries_left} retries left")
+    lines = ["fencing " + " · ".join(parts)]
+    if live:
+        budget = rejects[-1].get("budget") if rejects else None
+        banner = stale_params_banner(staleness, budget)
         if banner is not None:
             lines.append(banner)
     return lines

@@ -64,7 +64,9 @@ EVENT_KINDS: Dict[str, str] = {
     "ckpt_reject": "hot-reload refused a checkpoint: health-gate anomalies, shape mismatch, or missing journal",
     "ckpt_begin": "a checkpoint write started (path, step, blocking flag, seconds queued behind the async writer)",
     "ckpt_end": "a checkpoint write finished: bytes, write ms, manifest verified — or status=failed with the error",
-    "ckpt_skipped": "resume selection rejected a checkpoint (corrupt / truncated / unreadable) with the reason",
+    "ckpt_skipped": "resume selection rejected a checkpoint (corrupt / truncated / unreadable / incomplete_group) with the reason",
+    "params_reject": "decoupled promotion gate fenced a trainer update off the player: reason, step, staleness vs budget (escalate=true on the budget-exhausting rejection, fsync'd)",
+    "rollback": "quarantined train-step failure absorbed: trainer params+opt_state restored from the last-good snapshot — error, restored iteration, retries left (fsync'd)",
     "preempted": "graceful preemption: emergency snapshot landed at a loop boundary; the process exits with code 75 (fsync'd)",
     "restart": "supervisor respawned the run after a non-clean exit: attempt, rc, backoff, measured downtime, resume source",
     "run_end": "completed / halted / aborted / preempted — absent after a kill",
@@ -114,6 +116,8 @@ METRICS: Dict[str, str] = {
     "sheeprl_ckpt_failures_total": "checkpoint writes that failed (journaled as ckpt_end status=failed)",
     "sheeprl_ckpt_write_seconds_total": "cumulative serialize+fsync wall-clock spent writing checkpoints",
     "sheeprl_restarts_total": "kill/resume cycles the supervisor performed before this process (SHEEPRL_SUPERVISOR_RESTARTS)",
+    "sheeprl_params_rejected_total": "trainer updates the decoupled promotion gate fenced off the player (params_reject events)",
+    "sheeprl_rollbacks_total": "quarantined train-step failures absorbed by restoring the last-good snapshot (rollback events)",
     # interval gauges (Telemetry/... keys, prefix-stripped and sanitized)
     "sheeprl_mfu": "model FLOPs utilization vs the device-kind peak",
     "sheeprl_tflops_per_sec": "achieved TFLOP/s over the last interval",
@@ -133,6 +137,8 @@ METRICS: Dict[str, str] = {
     "sheeprl_ckpt_last_step": "policy step of the newest verified checkpoint written by this run",
     "sheeprl_ckpt_age_seconds": "seconds since the newest verified checkpoint landed on disk",
     "sheeprl_ckpt_interval_seconds": "seconds between the last two checkpoint writes (the observed cadence)",
+    "sheeprl_param_staleness": "decoupled fencing: consecutive trainer updates the player has been held back from (0 = acting on fresh params)",
+    "sheeprl_param_staleness_budget": "decoupled fencing: the configured max_staleness budget the staleness gauge escalates against",
     # goodput gauges (run lifecycle layer, prefix-stripped)
     "sheeprl_run_state": "run-state machine index into goodput.STATES (5 = stalled)",
     "sheeprl_goodput": "cumulative productive share since open: train-span seconds / wall seconds",

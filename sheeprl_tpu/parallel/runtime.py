@@ -172,7 +172,19 @@ class Runtime:
         diagnostics facade carries one (async off-critical-path writer +
         manifest sidecar + ckpt_begin/ckpt_end journaling); otherwise a plain
         synchronous save that still writes the manifest, so resume-time
-        verification works for every producer (eval helpers, tests, bench)."""
+        verification works for every producer (eval helpers, tests, bench).
+
+        Multi-process (``jax.distributed``) saves are *coordinated* group
+        snapshots (resilience/coordination.py): barrier → broadcast-agreed
+        step → one ``ckpt_<step>_<rank>.ckpt`` shard per rank with a group
+        manifest, so resume selection can reject torn snapshots.  The
+        single-process path below is bit-identical to the pre-coordination
+        behavior."""
+        if jax.process_count() > 1:
+            from sheeprl_tpu.resilience.coordination import coordinated_save
+
+            coordinated_save(self, path, state)
+            return
         if self.is_global_zero:
             diagnostics = self.diagnostics
             routed = diagnostics is not None and diagnostics.save_checkpoint(path, state)
@@ -183,8 +195,18 @@ class Runtime:
         self.barrier()
 
     def load(self, path: str) -> Dict[str, Any]:
+        """Checkpoint read; a non-zero rank of a multi-process run loads its
+        own shard of a coordinated group when one exists next to the
+        (canonical, rank-0) resolved path, falling back to the rank-0 file —
+        today's state is replicated, so the fallback is always valid."""
         from sheeprl_tpu.utils.checkpoint import load_state
 
+        if jax.process_count() > 1 and jax.process_index() > 0:
+            from sheeprl_tpu.resilience.coordination import rank_shard_path
+
+            mine = rank_shard_path(path, jax.process_index())
+            if os.path.isfile(mine):
+                path = mine
         return load_state(path)
 
     def seed_everything(self, seed: int) -> jax.Array:

@@ -35,6 +35,22 @@ facade and ``Runtime.save``:
   ``tools/goodput_report.py`` measures time-to-recover on real kill/resume
   cycles.
 
+ISSUE 14 (the robustness half of ROADMAP item 4) adds three more:
+
+* :mod:`~sheeprl_tpu.resilience.isolation` — last-good param fencing for the
+  decoupled topology (promotion gate + ``params_reject`` + the
+  ``Telemetry/param_staleness`` gauge) and train-step quarantine & rollback
+  (double-buffered last-good snapshot, journaled ``rollback``,
+  ``retry_budget``-bounded);
+* :mod:`~sheeprl_tpu.resilience.coordination` — coordinated multi-host
+  snapshots: barrier + broadcast-agreed step + one manifest-grouped shard
+  per rank; resume selection skips torn groups
+  (``ckpt_skipped reason=incomplete_group``);
+* :mod:`~sheeprl_tpu.resilience.chaos` — scripted multi-fault schedules
+  (``diagnostics.resilience.chaos.schedule``) and the ``sheeprl-chaos`` /
+  ``tools/chaos_drill.py`` drill asserting recovery invariants through the
+  real CLI.
+
 The :class:`~sheeprl_tpu.resilience.monitor.ResilienceMonitor` ties the
 pillars to the facade (journal hooks, ``/metrics`` counters, config knobs
 under ``diagnostics.resilience``).  See ``howto/resilience.md``.
@@ -43,6 +59,13 @@ under ``diagnostics.resilience``).  See ``howto/resilience.md``.
 from __future__ import annotations
 
 from sheeprl_tpu.resilience.async_writer import AsyncCheckpointWriter, host_snapshot
+from sheeprl_tpu.resilience.chaos import ChaosMonitor, ChaosTrainerError
+from sheeprl_tpu.resilience.coordination import (
+    coordinated_save,
+    group_status,
+    rank_shard_path,
+)
+from sheeprl_tpu.resilience.isolation import IsolationHalt, IsolationMonitor
 from sheeprl_tpu.resilience.manifest import (
     MANIFEST_SUFFIX,
     newest_verified_checkpoint,
@@ -58,12 +81,19 @@ from sheeprl_tpu.resilience.preemption import PREEMPTED_EXIT_CODE, PreemptedExit
 
 __all__ = [
     "AsyncCheckpointWriter",
+    "ChaosMonitor",
+    "ChaosTrainerError",
+    "IsolationHalt",
+    "IsolationMonitor",
     "MANIFEST_SUFFIX",
     "PREEMPTED_EXIT_CODE",
     "PreemptedExit",
     "PreemptionGuard",
     "ResilienceMonitor",
+    "coordinated_save",
+    "group_status",
     "host_snapshot",
+    "rank_shard_path",
     "newest_verified_checkpoint",
     "read_manifest",
     "reap_orphan_tmps",

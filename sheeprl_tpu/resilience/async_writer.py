@@ -76,10 +76,20 @@ class AsyncCheckpointWriter:
         self.last_interval_s: Optional[float] = None
 
     # -- producer side (the training loop) ----------------------------------
-    def submit(self, path: str, state: Mapping[str, Any], step: Optional[int] = None) -> float:
+    def submit(
+        self,
+        path: str,
+        state: Mapping[str, Any],
+        step: Optional[int] = None,
+        group: Optional[Mapping[str, Any]] = None,
+        delay_s: Optional[float] = None,
+    ) -> float:
         """Snapshot ``state`` to host and enqueue the write; returns the
         critical-path seconds the caller paid.  Blocks only when
-        ``max_pending`` snapshots are already waiting (backpressure)."""
+        ``max_pending`` snapshots are already waiting (backpressure).
+        ``group`` is the coordinated-snapshot manifest record; ``delay_s``
+        is the chaos ``slow_write`` injection — the writer thread sleeps it
+        before serializing, inflating write cost OFF the critical path."""
         t0 = self._clock()
         snapshot = host_snapshot(state)
         with self._cond:
@@ -87,7 +97,7 @@ class AsyncCheckpointWriter:
                 raise RuntimeError("AsyncCheckpointWriter is closed")
             while len(self._queue) >= self.max_pending and not self._closed:
                 self._cond.wait(timeout=1.0)
-            self._queue.append((str(path), snapshot, step, time.time()))
+            self._queue.append((str(path), snapshot, step, time.time(), group, delay_s))
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._worker, name="sheeprl-ckpt-writer", daemon=True
@@ -104,24 +114,38 @@ class AsyncCheckpointWriter:
                     self._cond.wait(timeout=1.0)
                 if not self._queue:
                     return  # closed and drained
-                path, snapshot, step, enqueued_t = self._queue.popleft()
+                path, snapshot, step, enqueued_t, group, delay_s = self._queue.popleft()
                 self._writing = True
                 self._cond.notify_all()
             try:
-                self._write_one(path, snapshot, step, enqueued_t)
+                self._write_one(path, snapshot, step, enqueued_t, group, delay_s)
             finally:
                 with self._cond:
                     self._writing = False
                     self._cond.notify_all()
 
-    def _write_one(self, path: str, snapshot: Any, step: Optional[int], enqueued_t: float) -> None:
+    def _write_one(
+        self,
+        path: str,
+        snapshot: Any,
+        step: Optional[int],
+        enqueued_t: float,
+        group: Optional[Mapping[str, Any]] = None,
+        delay_s: Optional[float] = None,
+    ) -> None:
         from sheeprl_tpu.resilience.manifest import checkpoint_step, save_verified_checkpoint
 
+        if delay_s:
+            time.sleep(delay_s)  # chaos slow_write: cost lands in write_ms/queued_s
         step = step if step is not None else checkpoint_step(path, snapshot)
         queued_s = round(max(0.0, time.time() - enqueued_t), 3)
         self._journal("ckpt_begin", path=path, step=step, blocking=False, queued_s=queued_s)
         try:
-            result = save_verified_checkpoint(path, snapshot, step=step)
+            # group threaded only when coordinated: the single-process call is
+            # bit-identical to the pre-coordination one (and compatible with
+            # test doubles carrying the original signature)
+            kwargs = {"group": group} if group is not None else {}
+            result = save_verified_checkpoint(path, snapshot, step=step, **kwargs)
         except Exception as err:
             self.failed_total += 1
             self._journal(

@@ -1,0 +1,302 @@
+"""Chaos harness: scripted fault schedules + the ``sheeprl-chaos`` drill.
+
+The resilience subsystem's fault injections grew one knob at a time
+(``inject_nan_iter``, ``inject_preempt_iter``, ``inject_stall_iter``, ...),
+each drilling ONE failure in isolation.  Production preemptible pools deliver
+*schedules* of faults; this module scripts them:
+
+``diagnostics.resilience.chaos.schedule`` is a list of
+``{iter: N, fault: <name>}`` entries (one-shot each):
+
+* ``nan_grads`` — poison every float leaf of the train batch at loop
+  iteration N (the sentinel/fencing path end-to-end: ``params_reject`` →
+  ``rollback`` under ``sentinel.policy=halt``);
+* ``trainer_exception`` — raise :class:`ChaosTrainerError` at the train
+  dispatch boundary (the quarantine path without NaNs);
+* ``slow_write`` — the next checkpoint write sleeps
+  ``chaos.slow_write_s`` inside the (async) writer: drills write-cost
+  accounting and the ``!! NO-RECENT-CKPT`` freshness banner without
+  touching the critical path;
+* ``preempt`` — behave as if a preemption signal arrived (same chain as
+  ``inject_preempt_iter``: emergency snapshot → ``preempted`` → exit 75).
+
+Every firing journals ``fault_injection`` with ``kind=<fault>`` and
+``source=chaos``.  ``tools/chaos_drill.py`` / ``sheeprl-chaos`` runs a
+schedule through the REAL CLI in a subprocess and asserts the recovery
+invariants (run survives, the journal carries the expected event chain, the
+final checkpoint manifest verifies) — the executable form of the recovery
+contract in ``howto/resilience.md``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+#: The fault vocabulary a schedule entry may name.
+FAULTS = ("nan_grads", "trainer_exception", "slow_write", "preempt")
+
+
+class ChaosTrainerError(RuntimeError):
+    """Injected trainer failure (fault ``trainer_exception``): raised at the
+    train dispatch boundary so the loop's quarantine path absorbs it exactly
+    like a real mid-dispatch blowup."""
+
+
+def parse_schedule(schedule: Any) -> List[Dict[str, Any]]:
+    """Validate a chaos schedule (list of ``{iter, fault}`` mappings) into
+    normalized entries; raises ``ValueError`` with the offending entry."""
+    if schedule in (None, ""):
+        return []
+    if not isinstance(schedule, Sequence) or isinstance(schedule, (str, bytes)):
+        raise ValueError(
+            f"diagnostics.resilience.chaos.schedule must be a list of "
+            f"{{iter: N, fault: name}} entries, got {schedule!r}"
+        )
+    out: List[Dict[str, Any]] = []
+    for entry in schedule:
+        if not isinstance(entry, Mapping):
+            raise ValueError(f"chaos schedule entry must be a mapping, got {entry!r}")
+        fault = entry.get("fault")
+        if fault not in FAULTS:
+            raise ValueError(
+                f"chaos schedule entry names unknown fault {fault!r}; valid: {list(FAULTS)}"
+            )
+        raw_iter = entry.get("iter")
+        if raw_iter is None or int(raw_iter) < 1:
+            raise ValueError(
+                f"chaos schedule entry needs iter >= 1 (1 = first loop iteration), got {entry!r}"
+            )
+        out.append({"iter": int(raw_iter), "fault": str(fault), "fired": False})
+    return out
+
+
+class ChaosMonitor:
+    """Schedule executor behind ``ResilienceMonitor``: one-shot fault firings
+    keyed by loop iteration, each journaled as ``fault_injection``."""
+
+    def __init__(self, cfg: Optional[Mapping[str, Any]]):
+        cfg = cfg or {}
+        chaos_cfg = ((cfg.get("diagnostics") or {}).get("resilience") or {}).get("chaos") or {}
+        self.schedule = parse_schedule(chaos_cfg.get("schedule"))
+        raw_slow = chaos_cfg.get("slow_write_s")
+        self.slow_write_s = 2.0 if raw_slow is None else float(raw_slow)
+        if self.slow_write_s <= 0:
+            raise ValueError(
+                f"diagnostics.resilience.chaos.slow_write_s must be > 0, got {self.slow_write_s}"
+            )
+        self.enabled = bool(self.schedule)
+        self._journal_fn: Optional[Callable[..., None]] = None
+        self._opened = False
+
+    def open(self, journal_fn: Optional[Callable[..., None]] = None) -> None:
+        if self._opened:
+            return
+        self._journal_fn = journal_fn
+        self._opened = True
+
+    def take(self, iter_num: int, fault: str) -> bool:
+        """True when an unfired schedule entry matches ``(iter_num, fault)``
+        — marks it fired and journals the injection."""
+        if not self._opened or not self.enabled:
+            return False
+        for entry in self.schedule:
+            if entry["fired"] or entry["fault"] != fault or entry["iter"] != int(iter_num):
+                continue
+            entry["fired"] = True
+            if self._journal_fn is not None:
+                self._journal_fn(
+                    "fault_injection", iter_num=int(iter_num), kind=fault, source="chaos"
+                )
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# the drill CLI (tools/chaos_drill.py / sheeprl-chaos)
+# ---------------------------------------------------------------------------
+
+#: Out-of-the-box drill workload: a tiny decoupled PPO run (1 player + 1
+#: trainer) on the dummy env — the topology the fencing/rollback contract is
+#: about.  Callers targeting real hardware pass their own overrides after
+#: ``--``.
+DEFAULT_OVERRIDES = [
+    "exp=ppo_decoupled",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=1",
+    "metric.log_every=1",
+    "fabric.devices=2",
+    "fabric.accelerator=cpu",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.run_test=False",
+    "algo.total_steps=96",
+    "checkpoint.every=16",
+    "checkpoint.save_last=True",
+]
+
+#: Per-fault recovery invariants: (expected exit codes, journal event kinds
+#: that must appear IN ORDER after the injection).
+_EXPECTED = {
+    "nan_grads": ((0,), ("fault_injection", "params_reject", "rollback", "run_end")),
+    "trainer_exception": ((0,), ("fault_injection", "rollback", "run_end")),
+    "slow_write": ((0,), ("fault_injection", "ckpt_end", "run_end")),
+    "preempt": ((75,), ("fault_injection", "preempted", "run_end")),
+}
+
+
+def _ordered_subsequence(kinds: Sequence[str], expected: Sequence[str]) -> bool:
+    it = iter(kinds)
+    return all(kind in it for kind in expected)
+
+
+def run_drill(
+    schedule: List[Dict[str, Any]],
+    overrides: Sequence[str],
+    run_dir_root: str = "logs/runs",
+    timeout_s: float = 600.0,
+) -> Dict[str, Any]:
+    """Run one scripted schedule through the real CLI (subprocess) and check
+    the recovery invariants; returns the machine-readable verdict."""
+    from sheeprl_tpu.diagnostics.journal import find_journal, read_journal
+    from sheeprl_tpu.resilience.manifest import newest_verified_checkpoint
+
+    faults = [e["fault"] for e in schedule]
+    schedule_yaml = "[" + ",".join(f"{{iter: {e['iter']}, fault: {e['fault']}}}" for e in schedule) + "]"
+    run_name = "chaos_drill"
+    args = list(overrides) + [
+        f"run_name={run_name}",
+        f"diagnostics.resilience.chaos.schedule={schedule_yaml}",
+        # the rollback chain needs the halting sentinel armed; harmless for
+        # the other faults (the drill IS the halt-policy recovery proof)
+        "diagnostics.sentinel.enabled=True",
+        "diagnostics.sentinel.policy=halt",
+    ]
+    from sheeprl_tpu.utils.utils import subprocess_cli_env
+
+    # the default decoupled workload needs >= 2 (virtual) devices; the shared
+    # helper replaces any inherited device-count pin and makes the checkout
+    # importable from the drill's cwd
+    env = subprocess_cli_env(device_count=2)
+    proc = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu", *args],
+        env=env,
+        timeout=timeout_s,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+    verdict: Dict[str, Any] = {
+        "schedule": [{k: e[k] for k in ("iter", "fault")} for e in schedule],
+        "exit_code": proc.returncode,
+        "checks": {},
+        "ok": True,
+    }
+
+    def check(name: str, ok: bool, detail: Any = None) -> None:
+        verdict["checks"][name] = {"ok": bool(ok), **({"detail": detail} if detail is not None else {})}
+        verdict["ok"] = verdict["ok"] and bool(ok)
+
+    expected_codes = tuple({c for f in faults for c in _EXPECTED[f][0]}) or (0,)
+    check("exit_code", proc.returncode in expected_codes, {"got": proc.returncode, "want": list(expected_codes)})
+
+    # the run dir is derived from the composed root_dir/run_name; search for
+    # the journal under the conventional layout.  Newest-mtime wins: a second
+    # drill in the same logs tree must judge ITS run, not a stale version_N
+    candidates = []
+    for root, _dirs, files in os.walk(run_dir_root):
+        if "journal.jsonl" in files and f"/{run_name}/" in (root + "/"):
+            found = find_journal(root)
+            if found is not None:
+                candidates.append(found)
+    journal_path = max(candidates, key=os.path.getmtime, default=None)
+    if journal_path is None:
+        check("journal", False, f"no journal.jsonl for run_name={run_name} under {run_dir_root}")
+        return verdict
+    events = read_journal(journal_path)
+    kinds = [e.get("event") for e in events]
+    verdict["journal"] = journal_path
+
+    for fault in faults:
+        chain = _EXPECTED[fault][1]
+        check(f"chain:{fault}", _ordered_subsequence(kinds, chain), {"want_in_order": list(chain)})
+    if "nan_grads" in faults and proc.returncode == 0:
+        # after the rollback, promotions must be healthy again: the run's
+        # LAST metric interval carries staleness 0 (gauge present => gate ran)
+        last_metrics = next(
+            (e.get("metrics") or {} for e in reversed(events) if e.get("event") == "metrics"), {}
+        )
+        staleness = last_metrics.get("Telemetry/param_staleness")
+        check("healthy_promotions", staleness == 0, {"final_param_staleness": staleness})
+    run_end = next((e for e in reversed(events) if e.get("event") == "run_end"), None)
+    want_status = "preempted" if "preempt" in faults else "completed"
+    check("run_end", run_end is not None and run_end.get("status") == want_status, run_end)
+
+    best, skipped = newest_verified_checkpoint(os.path.dirname(journal_path))
+    check("final_checkpoint_verifies", best is not None, {"checkpoint": best, "skipped": skipped})
+    return verdict
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``sheeprl-chaos``: run a scripted fault schedule through the real CLI
+    and assert the recovery invariants.
+
+    Usage::
+
+        sheeprl-chaos --drill nan_grads [--iter 2]
+        sheeprl-chaos --schedule '[{iter: 2, fault: nan_grads}, {iter: 4, fault: slow_write}]'
+        sheeprl-chaos --drill trainer_exception -- exp=ppo_decoupled env=dummy ...
+
+    Without explicit overrides after ``--`` a tiny 1-player+1-trainer
+    decoupled PPO run on the dummy env is used (CPU, ~a minute).  Exit 0 =
+    every invariant held; 1 = a recovery invariant failed.
+    """
+    import argparse
+
+    import yaml
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    overrides: List[str] = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, overrides = argv[:split], argv[split + 1 :]
+    parser = argparse.ArgumentParser(
+        prog="sheeprl-chaos", description=main.__doc__.splitlines()[0]
+    )
+    parser.add_argument("--drill", choices=FAULTS, help="single-fault shorthand")
+    parser.add_argument("--iter", type=int, default=2, help="iteration for --drill (default 2)")
+    parser.add_argument("--schedule", help="YAML list of {iter: N, fault: name} entries")
+    parser.add_argument("--timeout", type=float, default=600.0, help="drill wall-clock budget (s)")
+    args = parser.parse_args(argv)
+
+    if bool(args.drill) == bool(args.schedule):
+        parser.error("pass exactly one of --drill or --schedule")
+    raw = [{"iter": args.iter, "fault": args.drill}] if args.drill else yaml.safe_load(args.schedule)
+    schedule = parse_schedule(raw)
+    if not schedule:
+        parser.error("empty chaos schedule")
+
+    verdict = run_drill(schedule, overrides or DEFAULT_OVERRIDES, timeout_s=args.timeout)
+    print(json.dumps(verdict), flush=True)
+    for name, result in verdict["checks"].items():
+        mark = "ok " if result["ok"] else "FAIL"
+        detail = result.get("detail")
+        print(f"  [{mark}] {name}" + (f" — {detail}" if detail is not None and not result["ok"] else ""))
+    print("chaos drill: " + ("PASSED" if verdict["ok"] else "FAILED"))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via tools/chaos_drill.py
+    sys.exit(main())

@@ -1,0 +1,144 @@
+"""Coordinated multi-host snapshots (ISSUE 14 tentpole pillar 3).
+
+Checkpointing was rank-0-only: under ``jax.distributed`` only the global-zero
+host wrote a file, so per-host state (and any future per-rank sharded state)
+was silently dropped and a restart could resume from a step no other rank
+agreed on.  This module makes the checkpoint a *group* artifact:
+
+1. every process barriers at the checkpoint boundary (no rank writes while
+   another is still training toward a different step);
+2. the group **broadcast-agrees on the step** (rank 0's parse of the
+   checkpoint path wins — the one number all manifests must share);
+3. each rank writes its own shard — ``ckpt_<step>_<rank>.ckpt`` — whose
+   manifest sidecar records ``{"group": {"world_size", "rank",
+   "group_step"}}``; rank 0 still routes through the async writer, other
+   ranks write blocking (their loops are at the barrier anyway);
+4. resume-time selection (``resilience/manifest.py``) treats a step as
+   resumable only when EVERY participating rank's shard verifies — a torn
+   snapshot (one shard missing/corrupt/step-mismatched) is skipped with a
+   journaled ``ckpt_skipped reason=incomplete_group`` and the previous
+   complete group is used instead.
+
+Single-process runs never enter this path: ``Runtime.save`` keeps its exact
+pre-existing behavior (no group record in the manifest, bit-identical
+sidecars), so every current producer/consumer is untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+from sheeprl_tpu.resilience.manifest import (
+    checkpoint_step,
+    read_manifest,
+    save_verified_checkpoint,
+    verify_checkpoint,
+)
+
+_RANK_RE = re.compile(r"^(?P<stem>.*ckpt_\d+)_(?P<rank>\d+)\.ckpt$")
+_FALLBACK_RANK_RE = re.compile(r"^(?P<stem>.*)\.rank\d+(?P<ext>\.[^.]+)$")
+
+
+def rank_shard_path(ckpt_path: str, rank: int) -> str:
+    """``.../ckpt_<step>_0.ckpt`` → ``.../ckpt_<step>_<rank>.ckpt`` (the
+    loops' filename convention); a path without the rank suffix gains
+    ``.rank<r>`` before its extension so exotic names still shard safely.
+    Idempotent on BOTH spellings: mapping an existing shard to another rank
+    replaces its marker (group_status derives siblings from a shard path,
+    so ``last.rank0.ckpt`` must map to ``last.rank1.ckpt``, never to
+    ``last.rank0.rank1.ckpt``)."""
+    ckpt_path = str(ckpt_path)
+    match = _RANK_RE.match(ckpt_path)
+    if match:
+        return f"{match.group('stem')}_{int(rank)}.ckpt"
+    match = _FALLBACK_RANK_RE.match(ckpt_path)
+    if match:
+        return f"{match.group('stem')}.rank{int(rank)}{match.group('ext')}"
+    root, ext = os.path.splitext(ckpt_path)
+    return f"{root}.rank{int(rank)}{ext}"
+
+
+def group_record(world_size: int, rank: int, group_step: Optional[int]) -> Dict[str, Any]:
+    return {"world_size": int(world_size), "rank": int(rank), "group_step": group_step}
+
+
+def group_status(
+    ckpt_path: str, deep: bool = True, assume_verified: Tuple[int, ...] = ()
+) -> Tuple[bool, str]:
+    """``(complete, reason)`` for the snapshot group a checkpoint belongs to.
+
+    A checkpoint without a group record (single-process, legacy) is trivially
+    complete.  A grouped one is complete only when every rank's shard exists,
+    verifies, and records the same ``group_step`` — anything else is
+    ``incomplete_group`` (the torn-snapshot skip reason).  ``assume_verified``
+    names ranks whose shard content the caller has ALREADY (deep-)verified —
+    their manifests are still cross-checked, but multi-GB shards are not
+    re-hashed a second time.
+    """
+    entry = read_manifest(ckpt_path)
+    group = (entry or {}).get("group")
+    if not isinstance(group, Mapping):
+        return True, "ungrouped"
+    world = int(group.get("world_size", 1) or 1)
+    if world <= 1:
+        return True, "ungrouped"
+    step = group.get("group_step")
+    for rank in range(world):
+        shard = rank_shard_path(ckpt_path, rank)
+        if rank not in assume_verified:
+            ok, _reason = verify_checkpoint(shard, deep=deep)
+            if not ok:
+                return False, "incomplete_group"
+        sibling = read_manifest(shard)
+        sib_group = (sibling or {}).get("group")
+        if not isinstance(sib_group, Mapping) or sib_group.get("group_step") != step:
+            return False, "incomplete_group"
+    return True, "group_verified"
+
+
+def shard_rank(ckpt_path: str) -> Optional[int]:
+    """The rank recorded in a checkpoint's manifest group, or None for
+    ungrouped checkpoints — resume selection only ever returns the rank-0
+    (canonical) shard of a group."""
+    entry = read_manifest(ckpt_path)
+    group = (entry or {}).get("group")
+    if not isinstance(group, Mapping) or int(group.get("world_size", 1) or 1) <= 1:
+        return None
+    try:
+        return int(group.get("rank", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def coordinated_save(runtime, path: str, state: Mapping[str, Any]) -> None:
+    """The multi-process ``Runtime.save`` protocol: barrier → broadcast-agree
+    on the step → every rank writes its shard (+ group manifest) → barrier.
+
+    Rank 0 routes through the diagnostics resilience layer when present
+    (async writer, ``ckpt_begin``/``ckpt_end`` journaling) exactly like the
+    single-process path; other ranks write blocking — they are parked at the
+    exit barrier regardless, and a blocking write is its own durability
+    proof for the group-completeness check.
+    """
+    import jax
+
+    world = jax.process_count()
+    rank = jax.process_index()
+    # entry barrier: no shard is written while another rank still trains
+    runtime.barrier()
+    step = runtime.broadcast(checkpoint_step(path, state), src=0)
+    group = group_record(world, rank, step)
+    shard = rank_shard_path(path, rank)
+    diagnostics = getattr(runtime, "diagnostics", None)
+    routed = (
+        rank == 0
+        and diagnostics is not None
+        and diagnostics.save_checkpoint(shard, state, group=group)
+    )
+    if not routed:
+        save_verified_checkpoint(shard, state, step=step, group=group)
+    # exit barrier: every rank's write was at least submitted before any loop
+    # resumes (durability is the manifest group's job, not the barrier's)
+    runtime.barrier()

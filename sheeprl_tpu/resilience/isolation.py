@@ -1,0 +1,309 @@
+"""Fault isolation for the decoupled topology: last-good param fencing +
+train-step quarantine & rollback (ISSUE 14 — the robustness half of ROADMAP
+item 4).
+
+The actor/learner decoupling contract (IMPALA, Espeholt et al. 2018; SEED RL,
+Espeholt et al. 2020) is that the *player* tolerates learner trouble: the
+learner may stall, reject an update, or roll back while actors keep
+generating experience on the last parameters known to be good.  Before this
+module, ``ppo_decoupled``/``sac_decoupled`` handed every trainer update to
+the player unconditionally — one NaN batch corrupted the acting policy and a
+halting sentinel killed the whole run.  Two mechanisms, both configured by
+``diagnostics.resilience.isolation``:
+
+* **Promotion gate** (:meth:`IsolationMonitor.judge`) — the trainer→player
+  params hop only happens when the update judges healthy.  The verdict
+  consumes signals the loop ALREADY fetched for the health/sentinel layers
+  (the in-graph nonfinite count and the ``health_stats`` norms ride the
+  train step's one blocking ``fetch_values``), so fencing costs zero extra
+  device syncs.  A rejection journals ``params_reject`` (reason, step,
+  staleness) and the player keeps its last-good params; the
+  ``Telemetry/param_staleness`` gauge counts iterations-behind.  When
+  staleness exhausts ``max_staleness``, the monitor arms a *fence halt*: the
+  loop forces its checkpoint branch (an emergency snapshot of the last-good
+  state) and raises :class:`IsolationHalt`.
+
+* **Quarantine & rollback** (:meth:`IsolationMonitor.rollback`) — every
+  healthy promotion also refreshes an in-memory *last-good* host snapshot of
+  the trainer's params + optimizer state (double-buffered: the refresh
+  lands in the spare slot and swaps, so an interrupt mid-refresh can never
+  tear the restore source — same discipline as the async writer's
+  snapshot).  When the sentinel's ``halt`` policy trips, or ``chaos``
+  injects a trainer exception, the loop restores from that snapshot,
+  journals ``rollback`` (fsync'd), and keeps going — ``retry_budget``
+  bounds the incidents; the budget-exhausting failure re-raises and the run
+  dies the old way, now with N survivable incidents behind it.
+
+Single-process / coupled loops never call the hooks, so default-on costs
+them nothing.  See ``howto/resilience.md``.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence
+
+from sheeprl_tpu.diagnostics.sentinel import SentinelHalt
+
+
+class IsolationHalt(SentinelHalt):
+    """Raised when the param-staleness budget is exhausted (after the
+    emergency snapshot landed): a :class:`SentinelHalt` subclass so the CLI
+    closes the run with status ``halted`` exactly like a sentinel halt."""
+
+
+class IsolationMonitor:
+    """Promotion gate + last-good snapshot behind ``ResilienceMonitor``.
+
+    Configured by ``diagnostics.resilience.isolation``:
+
+    * ``enabled`` — arm the gate/rollback hooks (decoupled loops only);
+    * ``max_staleness`` — consecutive rejected promotions the player may act
+      through before the fence escalates to emergency-snapshot + halt;
+    * ``retry_budget`` — rollbacks allowed before a quarantined train-step
+      failure re-raises;
+    * ``reject_on_anomaly`` — also fence promotions while a learning-health
+      detector has an open anomaly (the "open sentinel anomaly" signal).
+    """
+
+    def __init__(self, cfg: Optional[Mapping[str, Any]], clock: Callable[[], float] = time.time):
+        cfg = cfg or {}
+        iso_cfg = ((cfg.get("diagnostics") or {}).get("resilience") or {}).get("isolation") or {}
+        self.enabled = bool(iso_cfg.get("enabled", True))
+        raw_staleness = iso_cfg.get("max_staleness")
+        self.max_staleness = 8 if raw_staleness is None else int(raw_staleness)
+        if self.max_staleness < 1:
+            raise ValueError(
+                f"diagnostics.resilience.isolation.max_staleness must be >= 1, got {self.max_staleness}"
+            )
+        raw_budget = iso_cfg.get("retry_budget")
+        self.retry_budget = 3 if raw_budget is None else int(raw_budget)
+        if self.retry_budget < 0:
+            raise ValueError(
+                f"diagnostics.resilience.isolation.retry_budget must be >= 0, got {self.retry_budget}"
+            )
+        self.reject_on_anomaly = bool(iso_cfg.get("reject_on_anomaly", True))
+        raw_refresh = iso_cfg.get("refresh_every")
+        self.refresh_every = 1 if raw_refresh is None else int(raw_refresh)
+        if self.refresh_every < 1:
+            raise ValueError(
+                f"diagnostics.resilience.isolation.refresh_every must be >= 1, got {self.refresh_every}"
+            )
+
+        self._clock = clock
+        self._journal_fn: Optional[Callable[..., None]] = None
+        self._sync_fn: Optional[Callable[[], None]] = None
+        self._opened = False
+        # gate state
+        self._gate_used = False
+        self.staleness = 0
+        self._rejected_total = 0
+        self._halt_due = False
+        # last-good snapshot: double-buffered (refresh fills the spare slot,
+        # then one reference assignment promotes it — never a torn current)
+        self._slots: list = [None, None]
+        self._current: Optional[int] = None
+        # rollback bookkeeping
+        self._rollbacks_total = 0
+        self._retries_left = self.retry_budget
+        self._healthy_promotions = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def open(
+        self,
+        journal_fn: Optional[Callable[..., None]] = None,
+        sync_fn: Optional[Callable[[], None]] = None,
+    ) -> None:
+        if self._opened:
+            return
+        self._journal_fn = journal_fn
+        self._sync_fn = sync_fn
+        self._opened = True
+
+    def _journal(self, event: str, **fields: Any) -> None:
+        if self._journal_fn is not None:
+            self._journal_fn(event, **fields)
+
+    # -- promotion gate ------------------------------------------------------
+    @staticmethod
+    def _nonfinite_stat(stats: Mapping[str, Any]) -> Optional[str]:
+        """First non-finite entry among the global health norms, or None.
+        Only the global scalars are judged — per-module detail can carry a
+        legitimately-zero dead module without vetoing the whole update."""
+        for key in ("grad_norm", "update_norm", "param_norm"):
+            value = stats.get(key)
+            if value is None:
+                continue
+            try:
+                if not math.isfinite(float(value)):
+                    return key
+            except (TypeError, ValueError):
+                continue
+        return None
+
+    def judge(
+        self,
+        iter_num: int,
+        step: Optional[int],
+        stats: Mapping[str, Any],
+        nonfinite: float = 0.0,
+        anomalies: Sequence[str] = (),
+    ) -> bool:
+        """One promotion verdict: True = hand the params to the player.
+
+        Reject reasons, in precedence order: ``nonfinite_update`` (the
+        in-graph sentinel flag counted > 0 optimizer steps non-finite),
+        ``nonfinite:<stat>`` (a fetched health norm is NaN/Inf), and
+        ``open_anomaly:<kinds>`` (a learning-health detector is active and
+        ``reject_on_anomaly`` is set).  A rejection journals
+        ``params_reject`` and bumps the staleness counter; exhausting
+        ``max_staleness`` arms the fence halt (fsync'd, one-shot).
+        """
+        if not self._opened or not self.enabled:
+            return True
+        self._gate_used = True
+        reason = None
+        if nonfinite and float(nonfinite) > 0:
+            reason = "nonfinite_update"
+        if reason is None:
+            bad = self._nonfinite_stat(stats or {})
+            if bad is not None:
+                reason = f"nonfinite:{bad}"
+        if reason is None and self.reject_on_anomaly and anomalies:
+            reason = "open_anomaly:" + ",".join(sorted(anomalies)[:4])
+        if reason is None:
+            self.staleness = 0
+            return True
+        self.staleness += 1
+        self._rejected_total += 1
+        # only NON-FINITE rejections may escalate to the fatal fence halt: an
+        # open learning-health anomaly is an advisory signal — it fences the
+        # player (staleness climbs, the banner fires) but a warn-level
+        # detector must never terminate a run that is updating finitely
+        escalate = (
+            self.staleness > self.max_staleness
+            and not self._halt_due
+            and not reason.startswith("open_anomaly")
+        )
+        self._journal(
+            "params_reject",
+            reason=reason,
+            step=step,
+            iter_num=int(iter_num),
+            staleness=self.staleness,
+            budget=self.max_staleness,
+            escalate=escalate,
+        )
+        if escalate:
+            self._halt_due = True
+            if self._sync_fn is not None:
+                # the escalation record must survive the halt that follows it
+                self._sync_fn()
+        return False
+
+    @property
+    def halt_due(self) -> bool:
+        """True once staleness exhausted the budget: the loop forces its
+        checkpoint branch (emergency snapshot) and raises through
+        ``Diagnostics.on_fence_halt``."""
+        return self._halt_due
+
+    # -- last-good snapshot --------------------------------------------------
+    def refresh(self, iter_num: int, params: Any, opt_state: Any) -> None:
+        """Refresh the last-good host snapshot after a healthy promotion
+        (one batched device→host fetch; self-owned copies, so later in-place
+        donation/mutation of the live trees cannot reach it).
+
+        The fetch is the one real cost of the layer — the full params +
+        optimizer state cross to the host — so ``refresh_every`` (default 1)
+        amortizes it: only every Nth healthy promotion snapshots, trading a
+        rollback target up to N-1 updates staler (by design already
+        tolerated — the player tolerates ``max_staleness`` of it).  The
+        FIRST healthy promotion always snapshots, so rollback is armed as
+        early as possible."""
+        if not self._opened or not self.enabled:
+            return
+        self._healthy_promotions += 1
+        if self._current is not None and (self._healthy_promotions - 1) % self.refresh_every != 0:
+            return
+        from sheeprl_tpu.resilience.async_writer import host_snapshot
+
+        spare = 1 - (self._current if self._current is not None else 1)
+        self._slots[spare] = {
+            "params": host_snapshot(params),
+            "opt_state": host_snapshot(opt_state),
+            "iter_num": int(iter_num),
+        }
+        self._current = spare
+
+    @property
+    def last_good(self) -> Optional[Dict[str, Any]]:
+        return self._slots[self._current] if self._current is not None else None
+
+    def can_absorb(self) -> bool:
+        """True while a quarantined failure could be rolled back: the layer
+        is armed, a last-good snapshot exists and retries remain.  Consulted
+        by ``Diagnostics.on_update`` so a halt the loop is about to absorb
+        does not close the facade under it."""
+        return (
+            self._opened
+            and self.enabled
+            and self._current is not None
+            and self._retries_left > 0
+            and not self._halt_due
+        )
+
+    def rollback(self, err: BaseException, iter_num: int, step: Optional[int]) -> Optional[Dict[str, Any]]:
+        """Consume one retry and return the last-good ``{params, opt_state,
+        iter_num}`` snapshot (the caller device-puts it back onto the
+        trainer mesh), or None when nothing can be restored — no snapshot
+        yet, layer off, or the retry budget is spent — in which case the
+        caller re-raises and the run dies the pre-isolation way."""
+        if not self.can_absorb():
+            return None
+        self._retries_left -= 1
+        self._rollbacks_total += 1
+        restored = self.last_good
+        self._journal(
+            "rollback",
+            iter_num=int(iter_num),
+            step=step,
+            error=repr(err)[:200],
+            restored_iter=restored["iter_num"],
+            retries_left=self._retries_left,
+            budget=self.retry_budget,
+        )
+        if self._sync_fn is not None:
+            # an incident record that must survive the next failure killing us
+            self._sync_fn()
+        return restored
+
+    # -- observability -------------------------------------------------------
+    def interval_metrics(self) -> Dict[str, float]:
+        """The staleness gauge, merged into every metric interval once the
+        gate has been consulted (coupled runs never grow the key)."""
+        if not self._gate_used:
+            return {}
+        return {"Telemetry/param_staleness": float(self.staleness)}
+
+    def gauges(self) -> Dict[str, float]:
+        if not self._gate_used:
+            return {}
+        return {
+            "Telemetry/param_staleness": float(self.staleness),
+            "Telemetry/param_staleness_budget": float(self.max_staleness),
+        }
+
+    def counters(self) -> Dict[str, Any]:
+        return {
+            "params_rejected_total": self._rejected_total,
+            "rollbacks_total": self._rollbacks_total,
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "params_rejected": self._rejected_total,
+            "rollbacks": self._rollbacks_total,
+            "rollback_retries_left": self._retries_left,
+        }

@@ -122,12 +122,17 @@ def write_manifest(
     state: Optional[Mapping[str, Any]] = None,
     step: Optional[int] = None,
     digest: Optional[Mapping[str, Any]] = None,
+    group: Optional[Mapping[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Write the sidecar for an already-landed checkpoint (atomic tmp+rename;
     a crash can only leave a checkpoint *without* a manifest — i.e. legacy,
     still resumable — never a manifest describing a half-written file).
     ``digest`` is the ``{"sha256", "bytes"}`` record ``save_state`` computed
-    while streaming the pickle out; without it the file is re-read."""
+    while streaming the pickle out; without it the file is re-read.
+    ``group`` is the coordinated multi-host record
+    (``{"world_size", "rank", "group_step"}`` — see
+    ``resilience/coordination.py``); single-process saves pass None and the
+    sidecar stays bit-identical to the pre-coordination format."""
     ckpt_path = str(ckpt_path)
     entry: Dict[str, Any] = {
         "format": MANIFEST_FORMAT,
@@ -139,6 +144,8 @@ def write_manifest(
     }
     if state is not None:
         entry["tree"] = tree_spec(state)
+    if group is not None:
+        entry["group"] = dict(group)
     out_path = manifest_path(ckpt_path)
     tmp = out_path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fp:
@@ -197,17 +204,18 @@ def verify_checkpoint(ckpt_path: str, deep: bool = True) -> Tuple[bool, str]:
 
 
 def save_verified_checkpoint(
-    path: str, state: Mapping[str, Any], step: Optional[int] = None
+    path: str, state: Mapping[str, Any], step: Optional[int] = None, group: Optional[Mapping[str, Any]] = None
 ) -> Dict[str, Any]:
     """Atomic checkpoint save + manifest sidecar; returns
     ``{path, step, bytes, write_ms}`` (the payload of a ``ckpt_end`` event).
     The content digest is computed while the pickle streams out — the
-    checkpoint is never read back."""
+    checkpoint is never read back.  ``group`` threads the coordinated
+    multi-host record into the sidecar (None for single-process saves)."""
     from sheeprl_tpu.utils.checkpoint import save_state
 
     t0 = time.perf_counter()
     digest = save_state(path, state, digest=True)
-    entry = write_manifest(path, state=state, step=step, digest=digest)
+    entry = write_manifest(path, state=state, step=step, digest=digest, group=group)
     return {
         "path": str(path),
         "step": entry["step"],
@@ -242,13 +250,36 @@ def newest_verified_checkpoint(
 ) -> Tuple[Optional[str], List[Dict[str, str]]]:
     """The newest checkpoint under ``root`` that verifies, plus a skip record
     for every newer sibling that did not — the "never crash on a corrupt
-    checkpoint" resume rule in one place."""
+    checkpoint" resume rule in one place.
+
+    Coordinated multi-host snapshots add a group rule: a checkpoint whose
+    manifest carries a ``group`` record is resumable only when EVERY
+    participating rank's shard verifies with the same ``group_step`` — a
+    torn group (one shard missing / corrupt / step-mismatched) is skipped
+    with reason ``incomplete_group``.  Only the rank-0 shard of a group is
+    ever returned (it is the canonical resume path; non-zero shards are
+    selection-invisible, not corrupt, so they get no skip record)."""
+    from sheeprl_tpu.resilience.coordination import group_status, shard_rank
+
     skipped: List[Dict[str, str]] = []
     for candidate in list_checkpoints(root):
+        # manifest-only rank check FIRST: non-zero shards are selection-
+        # invisible, so deep-hashing them just to discard would double the
+        # resume scan's read cost on multi-host checkpoint dirs
+        rank = shard_rank(candidate)
+        if rank is not None and rank != 0:
+            continue
         ok, reason = verify_checkpoint(candidate, deep=deep)
-        if ok:
-            return candidate, skipped
-        skipped.append({"path": candidate, "reason": reason})
+        if not ok:
+            skipped.append({"path": candidate, "reason": reason})
+            continue
+        # the candidate itself was just verified — group_status only hashes
+        # its SIBLING shards
+        complete, group_reason = group_status(candidate, deep=deep, assume_verified=(0,))
+        if not complete:
+            skipped.append({"path": candidate, "reason": group_reason})
+            continue
+        return candidate, skipped
     return None, skipped
 
 

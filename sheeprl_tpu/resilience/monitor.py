@@ -11,7 +11,11 @@ supervisor's hand-off env var).  Configured by ``diagnostics.resilience``:
 * ``preempt.enabled`` — install the SIGTERM/SIGINT graceful-preemption guard;
 * ``inject_preempt_iter`` — fault injection: behave as if a preemption signal
   arrived at the Nth loop iteration (1 = first), drilling the emergency-
-  snapshot → ``preempted`` → exit-75 chain through the real CLI.
+  snapshot → ``preempted`` → exit-75 chain through the real CLI;
+* ``isolation.*`` — last-good param fencing + quarantine/rollback for the
+  decoupled topology (:mod:`~sheeprl_tpu.resilience.isolation`);
+* ``chaos.*`` — scripted multi-fault schedules and the ``sheeprl-chaos``
+  drill (:mod:`~sheeprl_tpu.resilience.chaos`).
 """
 
 from __future__ import annotations
@@ -21,6 +25,8 @@ import time
 from typing import Any, Callable, Dict, Mapping, Optional
 
 from sheeprl_tpu.resilience.async_writer import AsyncCheckpointWriter
+from sheeprl_tpu.resilience.chaos import ChaosMonitor
+from sheeprl_tpu.resilience.isolation import IsolationMonitor
 from sheeprl_tpu.resilience.preemption import PreemptionGuard
 
 #: Set by the supervisor on every child it (re)spawns; exported as the
@@ -49,6 +55,16 @@ class ResilienceMonitor:
         self.preempt_signals = bool(preempt_cfg.get("enabled", True))
         inject = res_cfg.get("inject_preempt_iter")
         self.inject_preempt_iter = None if inject is None else int(inject)
+        # fault-isolation pillar (decoupled loops' fencing/rollback hooks) and
+        # the chaos schedule executor — both None when disabled, so every
+        # consumer is a cheap attribute check
+        isolation = IsolationMonitor(cfg)
+        self.isolation: Optional[IsolationMonitor] = isolation if isolation.enabled else None
+        chaos = ChaosMonitor(cfg)
+        self.chaos: Optional[ChaosMonitor] = chaos if chaos.enabled else None
+        self._chaos_preempt = False
+        self._slow_write_pending: Optional[float] = None
+        self._chaos_slow_write_s = chaos.slow_write_s
 
         self._clock = clock
         self._journal_fn: Optional[Callable[..., None]] = None
@@ -93,6 +109,10 @@ class ResilienceMonitor:
 
         for kind, fields in drain_journal_events():
             self._journal(kind, **fields)
+        if self.isolation is not None:
+            self.isolation.open(self._journal, self._sync)
+        if self.chaos is not None:
+            self.chaos.open(self._journal)
         if self._rank_zero and self.async_checkpoint:
             self._writer = AsyncCheckpointWriter(
                 journal_fn=self._journal, max_pending=self.max_pending
@@ -123,17 +143,27 @@ class ResilienceMonitor:
         if self._journal_fn is not None:
             self._journal_fn(event, **fields)
 
+    def _sync(self) -> None:
+        if self._sync_fn is not None:
+            self._sync_fn()
+
     # -- checkpoint routing (Runtime.save on global rank 0) ------------------
-    def save(self, path: str, state: Mapping[str, Any]) -> None:
+    def save(self, path: str, state: Mapping[str, Any], group: Optional[Mapping[str, Any]] = None) -> None:
         from sheeprl_tpu.resilience.manifest import checkpoint_step, save_verified_checkpoint
 
         step = checkpoint_step(path, state)
+        delay_s, self._slow_write_pending = self._slow_write_pending, None
         if self._writer is not None:
-            self._writer.submit(path, state, step=step)
+            self._writer.submit(path, state, step=step, group=group, delay_s=delay_s)
             return
+        if delay_s:
+            # chaos slow_write on the blocking path: the sleep IS on the
+            # critical path here — exactly the cost async_checkpoint removes
+            time.sleep(delay_s)
         self._journal("ckpt_begin", path=str(path), step=step, blocking=True, queued_s=0.0)
         try:
-            result = save_verified_checkpoint(path, state, step=step)
+            kwargs = {"group": group} if group is not None else {}
+            result = save_verified_checkpoint(path, state, step=step, **kwargs)
         except Exception as err:
             # mirror the async path's contract (ckpt_begin is never left
             # dangling, the failure counter moves), then re-raise: a blocking
@@ -164,12 +194,23 @@ class ResilienceMonitor:
 
     # -- preemption ----------------------------------------------------------
     def preempt_due(self, iter_num: int) -> bool:
-        """True once a preemption (signal or injected) is pending — the loop
-        then forces its checkpoint branch and calls ``Diagnostics.on_preempted``."""
+        """True once a preemption (signal, injected, or chaos-scheduled) is
+        pending — the loop then forces its checkpoint branch and calls
+        ``Diagnostics.on_preempted``.  Doubles as the chaos layer's per-
+        iteration tick (every loop already calls it right before the
+        checkpoint branch): a scheduled ``slow_write`` is armed here so the
+        very next save pays it."""
         if not self._opened:
             return False
+        if self.chaos is not None and self.chaos.take(iter_num, "slow_write"):
+            self._slow_write_pending = self._chaos_slow_write_s
         if self._guard is not None and self._guard.requested:
             self._preempt_reason = f"signal:{self._guard.signal_name}"
+            return True
+        if self.chaos is not None and self.chaos.take(iter_num, "preempt"):
+            self._chaos_preempt = True
+            self._preempt_reason = "chaos"
+        if self._chaos_preempt:
             return True
         if self.inject_preempt_iter is not None and int(iter_num) == self.inject_preempt_iter:
             if not self._inject_fired:
@@ -208,6 +249,14 @@ class ResilienceMonitor:
             "interval_s": self._last_interval_s,
         }
 
+    def interval_metrics(self) -> Dict[str, float]:
+        """Per-interval resilience gauges merged into the metric stream by
+        the facade — currently the fencing staleness counter (present only
+        once the decoupled promotion gate has run)."""
+        if self.isolation is None:
+            return {}
+        return self.isolation.interval_metrics()
+
     def snapshot(self) -> Dict[str, Any]:
         state = self._ckpt_state()
         gauges: Dict[str, float] = {}
@@ -226,14 +275,20 @@ class ResilienceMonitor:
             "restarts_total": self._restarts_total,
         }
         info = {"last_ckpt_path": state["last_path"]}
+        if self.isolation is not None:
+            gauges.update(self.isolation.gauges())
+            counters.update(self.isolation.counters())
         return {"gauges": gauges, "counters": counters, "info": info}
 
     def summary(self) -> Dict[str, Any]:
         """Closing totals merged into the ``telemetry_summary`` event."""
         state = self._ckpt_state()
-        return {
+        out = {
             "ckpts_written": state["written"],
             "ckpt_failures": state["failed"],
             "ckpt_write_seconds": state["write_seconds"],
             "restarts": self._restarts_total,
         }
+        if self.isolation is not None:
+            out.update(self.isolation.summary())
+        return out

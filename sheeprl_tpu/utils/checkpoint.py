@@ -169,34 +169,51 @@ class CheckpointCallback:
     def _delete_old_checkpoints(self, ckpt_folder: Path) -> None:
         """`keep_last` pruning (reference callback.py:145-148), elasticity-safe:
 
-        * the checkpoint the current run resumed from survives
-          (:data:`PROTECTED_CHECKPOINTS`);
-        * the last *verified* checkpoint survives — if none of the keepers
-          passes (shallow) manifest verification, the newest verified one in
-          the delete set is spared, so resume always has a valid target;
+        * pruning counts checkpoint **groups** (files sharing a parsed step),
+          not files: a coordinated multi-host snapshot writes one shard per
+          rank, and deleting any shard would tear the group — resume
+          selection then rejects every survivor with
+          ``reason=incomplete_group``, so groups live and die together
+          (single-process runs: one file per group, behavior unchanged);
+        * the checkpoint the current run resumed from survives — and so do
+          its group siblings (:data:`PROTECTED_CHECKPOINTS`);
+        * the last *verified* group survives — if none of the keeper groups
+          passes (shallow) verification of all its files, the newest fully
+          verified doomed group is spared, so resume always has a target;
         * orphaned ``.tmp`` files from interrupted writes are reaped (age-
           guarded: the async writer may legitimately own a young one);
         * a deleted checkpoint takes its manifest sidecar with it.
         """
         from sheeprl_tpu.resilience.manifest import (
             MANIFEST_SUFFIX,
+            checkpoint_step,
             reap_orphan_tmps,
             verify_checkpoint,
         )
 
         reap_orphan_tmps(str(ckpt_folder), max_age_s=TMP_ORPHAN_AGE_S)
         ckpts = sorted(ckpt_folder.glob("*.ckpt"), key=os.path.getmtime)
-        keepers, doomed = ckpts[-self.keep_last :], ckpts[: -self.keep_last]
-        if not doomed:
+        groups: Dict[Any, list] = {}
+        for p in ckpts:
+            step = checkpoint_step(str(p))
+            groups.setdefault(step if step is not None else str(p), []).append(p)
+        ordered = sorted(groups, key=lambda k: max(os.path.getmtime(p) for p in groups[k]))
+        keeper_keys, doomed_keys = ordered[-self.keep_last :], ordered[: -self.keep_last]
+        if not doomed_keys:
             return
-        spared: Set[str] = set()
-        if not any(verify_checkpoint(str(p), deep=False)[0] for p in keepers):
-            for candidate in reversed(doomed):
-                if verify_checkpoint(str(candidate), deep=False)[0]:
-                    spared.add(str(candidate))
+
+        def group_verifies(key: Any) -> bool:
+            return all(verify_checkpoint(str(p), deep=False)[0] for p in groups[key])
+
+        spared: Set[Any] = set()
+        if not any(group_verifies(k) for k in keeper_keys):
+            for candidate in reversed(doomed_keys):
+                if group_verifies(candidate):
+                    spared.add(candidate)
                     break
-        for old in doomed:
-            if str(old) in spared or os.path.abspath(old) in PROTECTED_CHECKPOINTS:
+        for key in doomed_keys:
+            if key in spared or any(os.path.abspath(p) in PROTECTED_CHECKPOINTS for p in groups[key]):
                 continue
-            old.unlink(missing_ok=True)
-            Path(str(old) + MANIFEST_SUFFIX).unlink(missing_ok=True)
+            for old in groups[key]:
+                old.unlink(missing_ok=True)
+                Path(str(old) + MANIFEST_SUFFIX).unlink(missing_ok=True)

@@ -60,7 +60,7 @@ class TensorBoardLogger(NoOpLogger):
         self._writer.close()
 
 
-class WandbLogger(NoOpLogger):  # pragma: no cover - wandb not in image
+class WandbLogger(NoOpLogger):  # stub-tested: tests/test_utils/test_logger_stubs.py
     name = "wandb"
 
     def __init__(self, project: str = "sheeprl_tpu", save_dir: str = ".", **kwargs: Any):
@@ -81,7 +81,7 @@ class WandbLogger(NoOpLogger):  # pragma: no cover - wandb not in image
         self._run.finish()
 
 
-class MLFlowLogger(NoOpLogger):  # pragma: no cover - mlflow not in image
+class MLFlowLogger(NoOpLogger):  # stub-tested: tests/test_utils/test_logger_stubs.py
     name = "mlflow"
 
     def __init__(self, experiment_name: str = "sheeprl_tpu", tracking_uri: Optional[str] = None, **kwargs: Any):

@@ -186,6 +186,31 @@ def get_diagnostics(runtime, cfg: Mapping[str, Any], log_dir: str):
     return diag
 
 
+def subprocess_cli_env(device_count: int | None = None) -> Dict[str, str]:
+    """Environment for spawning ``python -m sheeprl_tpu`` children from an
+    arbitrary cwd (chaos drills, bench topology pairs): force the CPU
+    platform, pin the virtual host-device count — REPLACING any inherited
+    pin, so the caller gets the mesh it asked for even under a test
+    harness's own ``XLA_FLAGS`` — and prepend this checkout to PYTHONPATH
+    (same discipline as the supervisor's ``_child_env``, which deliberately
+    does NOT force CPU: its children may own the real chip)."""
+    import re
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if device_count is not None:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", env.get("XLA_FLAGS", "")
+        ).strip()
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={int(device_count)}"
+        ).strip()
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    existing = env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = pkg_root + (os.pathsep + existing if existing else "")
+    return env
+
+
 def unbind_parameters(tree):
     """No-op placeholder mirroring the reference's ``unwrap_fabric``: parameters
     in JAX are plain pytrees of arrays, there is nothing to unwrap."""

@@ -1,0 +1,279 @@
+"""Chaos harness (ISSUE 14 tentpole pillar 4): schedule DSL validation,
+one-shot fault firing, and the acceptance e2e — a chaos-injected trainer NaN
+in a REAL decoupled PPO CLI run survives via ``params_reject`` → ``rollback``
+with a verified final checkpoint, while the same injection with isolation off
+kills the run (today's behavior)."""
+
+from __future__ import annotations
+
+import pytest
+
+from sheeprl_tpu.diagnostics import read_journal
+from sheeprl_tpu.diagnostics.sentinel import SentinelHalt
+from sheeprl_tpu.resilience.chaos import ChaosMonitor, ChaosTrainerError, parse_schedule
+
+PPO_DECOUPLED_TINY = [
+    "exp=ppo_decoupled",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=2",
+    "env.capture_video=False",
+    "buffer.memmap=False",
+    "metric.log_level=1",
+    "metric.log_every=1",
+    "fabric.devices=2",
+    "fabric.accelerator=cpu",
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=4",
+    "algo.update_epochs=1",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.run_test=False",
+]
+
+
+def test_parse_schedule_validates_entries():
+    assert parse_schedule(None) == []
+    assert parse_schedule([{"iter": 2, "fault": "nan_grads"}]) == [
+        {"iter": 2, "fault": "nan_grads", "fired": False}
+    ]
+    with pytest.raises(ValueError, match="unknown fault"):
+        parse_schedule([{"iter": 2, "fault": "meteor_strike"}])
+    with pytest.raises(ValueError, match="iter >= 1"):
+        parse_schedule([{"fault": "nan_grads"}])
+    with pytest.raises(ValueError, match="must be a list"):
+        parse_schedule({"iter": 2, "fault": "nan_grads"})
+
+
+def test_check_configs_rejects_bad_chaos_and_isolation_knobs():
+    from sheeprl_tpu.cli import check_configs
+    from sheeprl_tpu.config import compose
+
+    base = ["exp=ppo", "env=dummy", "env.id=discrete_dummy"]
+    with pytest.raises(ValueError, match="unknown fault"):
+        check_configs(compose(base + ["diagnostics.resilience.chaos.schedule=[{iter: 2, fault: nope}]"]))
+    with pytest.raises(ValueError, match="slow_write_s"):
+        check_configs(compose(base + ["diagnostics.resilience.chaos.slow_write_s=0"]))
+    with pytest.raises(ValueError, match="max_staleness"):
+        check_configs(compose(base + ["diagnostics.resilience.isolation.max_staleness=0"]))
+    with pytest.raises(ValueError, match="retry_budget"):
+        check_configs(compose(base + ["diagnostics.resilience.isolation.retry_budget=-1"]))
+    check_configs(compose(base + ["diagnostics.resilience.chaos.schedule=[{iter: 3, fault: preempt}]"]))
+
+
+def test_chaos_monitor_fires_each_entry_once():
+    events = []
+    monitor = ChaosMonitor(
+        {
+            "diagnostics": {
+                "resilience": {
+                    "chaos": {
+                        "schedule": [
+                            {"iter": 2, "fault": "nan_grads"},
+                            {"iter": 2, "fault": "slow_write"},
+                            {"iter": 4, "fault": "nan_grads"},
+                        ]
+                    }
+                }
+            }
+        }
+    )
+    monitor.open(lambda event, **fields: events.append({"event": event, **fields}))
+    assert not monitor.take(1, "nan_grads")
+    assert monitor.take(2, "nan_grads")
+    assert not monitor.take(2, "nan_grads")  # one-shot
+    assert monitor.take(2, "slow_write")  # distinct fault at the same iter
+    assert monitor.take(4, "nan_grads")  # second entry for the same fault
+    kinds = [(e["iter_num"], e["kind"]) for e in events]
+    assert kinds == [(2, "nan_grads"), (2, "slow_write"), (4, "nan_grads")]
+    assert all(e["event"] == "fault_injection" and e["source"] == "chaos" for e in events)
+
+
+def test_facade_raises_scheduled_trainer_exception_once(tmp_path):
+    from sheeprl_tpu.diagnostics import Diagnostics
+
+    cfg = {
+        "diagnostics": {
+            "enabled": True,
+            "resilience": {"chaos": {"schedule": [{"iter": 3, "fault": "trainer_exception"}]}},
+        }
+    }
+    diag = Diagnostics(cfg).open(str(tmp_path))
+    try:
+        diag.maybe_chaos_trainer_fault(2)  # not scheduled: no-op
+        with pytest.raises(ChaosTrainerError, match="iteration 3"):
+            diag.maybe_chaos_trainer_fault(3)
+        diag.maybe_chaos_trainer_fault(3)  # one-shot
+        # the quarantine path absorbs it once a snapshot exists
+        import numpy as np
+
+        diag.refresh_last_good(2, {"w": np.ones(2, np.float32)}, {"mu": np.zeros(2, np.float32)})
+        restored = diag.quarantine(ChaosTrainerError("chaos"), 3, 48)
+        assert restored is not None and restored["iter_num"] == 2
+    finally:
+        diag.close()
+    events = read_journal(str(tmp_path / "journal.jsonl"))
+    (fault,) = [e for e in events if e["event"] == "fault_injection"]
+    assert fault == {**fault, "iter_num": 3, "kind": "trainer_exception", "source": "chaos"}
+
+
+@pytest.mark.slow
+def test_chaos_nan_drill_survives_decoupled_run_with_verified_checkpoint(run_cli):
+    """Acceptance chain (ISSUE 14): chaos injects ``nan_grads`` into a REAL
+    decoupled PPO run at iteration 2 under ``sentinel.policy=halt``.  The
+    player completes the run on last-good params: the journal shows
+    ``fault_injection`` → ``params_reject`` → ``rollback`` → healthy
+    promotions; the process exits cleanly and the final checkpoint manifest
+    verifies."""
+    from pathlib import Path
+
+    from sheeprl_tpu.resilience.manifest import newest_verified_checkpoint, verify_checkpoint
+
+    run_cli(
+        *PPO_DECOUPLED_TINY,
+        "run_name=chaos_nan",
+        "algo.total_steps=80",  # 5 iterations of 16 policy steps
+        "checkpoint.every=16",
+        "checkpoint.save_last=True",
+        "diagnostics.resilience.chaos.schedule=[{iter: 2, fault: nan_grads}]",
+        "diagnostics.sentinel.enabled=True",
+        "diagnostics.sentinel.policy=halt",
+    )
+
+    run_dir = Path("logs") / "runs" / "ppo_decoupled" / "discrete_dummy" / "chaos_nan"
+    (journal_path,) = sorted(run_dir.rglob("journal.jsonl"))
+    events = read_journal(str(journal_path))
+    kinds = [e["event"] for e in events]
+
+    (fault,) = [e for e in events if e["event"] == "fault_injection"]
+    assert fault["kind"] == "nan_grads" and fault["source"] == "chaos" and fault["iter_num"] == 2
+    (reject,) = [e for e in events if e["event"] == "params_reject"]
+    assert reject["reason"] == "nonfinite_update" and reject["iter_num"] == 2
+    assert reject["staleness"] == 1 and reject["escalate"] is False
+    (rollback,) = [e for e in events if e["event"] == "rollback"]
+    assert rollback["iter_num"] == 2 and rollback["restored_iter"] == 1
+    assert "SentinelHalt" in rollback["error"]
+    # ordering: inject -> reject -> rollback, then the run keeps going
+    assert kinds.index("params_reject") > kinds.index("fault_injection")
+    assert kinds.index("rollback") > kinds.index("params_reject")
+
+    # healthy promotions after the incident: the final interval's staleness
+    # gauge is back to 0 and the run ended cleanly
+    last_metrics = next(
+        e["metrics"] for e in reversed(events) if e["event"] == "metrics"
+    )
+    assert last_metrics.get("Telemetry/param_staleness") == 0
+    assert events[-1]["event"] == "run_end" and events[-1]["status"] == "completed"
+
+    # the final checkpoint is verified (and was written AFTER the incident)
+    best, skipped = newest_verified_checkpoint(str(run_dir))
+    assert best is not None and skipped == []
+    assert verify_checkpoint(best, deep=True) == (True, "verified")
+
+
+@pytest.mark.slow
+def test_same_injection_without_isolation_kills_the_run(run_cli):
+    """The contrast proving the tentpole: pre-isolation behavior (gate and
+    rollback disabled) turns the SAME injection into run death."""
+    from pathlib import Path
+
+    with pytest.raises(SentinelHalt):
+        run_cli(
+            *PPO_DECOUPLED_TINY,
+            "run_name=chaos_nan_unfenced",
+            "algo.total_steps=80",
+            "checkpoint.every=16",
+            "diagnostics.resilience.chaos.schedule=[{iter: 2, fault: nan_grads}]",
+            "diagnostics.sentinel.enabled=True",
+            "diagnostics.sentinel.policy=halt",
+            "diagnostics.resilience.isolation.enabled=False",
+        )
+    run_dir = Path("logs") / "runs" / "ppo_decoupled" / "discrete_dummy" / "chaos_nan_unfenced"
+    (journal_path,) = sorted(run_dir.rglob("journal.jsonl"))
+    events = read_journal(str(journal_path))
+    assert not any(e["event"] in ("params_reject", "rollback") for e in events)
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    assert run_end["status"] == "halted"
+
+
+@pytest.mark.slow
+def test_staleness_exhaustion_halts_with_last_good_emergency_snapshot(run_cli):
+    """Fencing-only escalation (no rollback: sentinel stays at its warn/off
+    default, so the NaN update is APPLIED and every later iteration stays
+    non-finite): with ``max_staleness=1`` the second rejection escalates —
+    the run halts via ``IsolationHalt`` and the emergency snapshot carries
+    the LAST-GOOD params, not the live NaN ones."""
+    from pathlib import Path
+
+    import numpy as np
+
+    from sheeprl_tpu.resilience.isolation import IsolationHalt
+    from sheeprl_tpu.resilience.manifest import newest_verified_checkpoint
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    with pytest.raises(IsolationHalt):
+        run_cli(
+            *PPO_DECOUPLED_TINY,
+            "run_name=chaos_fence",
+            "algo.total_steps=160",  # far beyond what the fence allows
+            "checkpoint.every=1000000",  # only the emergency snapshot writes
+            "diagnostics.resilience.chaos.schedule=[{iter: 2, fault: nan_grads}]",
+            "diagnostics.resilience.isolation.max_staleness=1",
+        )
+    run_dir = Path("logs") / "runs" / "ppo_decoupled" / "discrete_dummy" / "chaos_fence"
+    (journal_path,) = sorted(run_dir.rglob("journal.jsonl"))
+    events = read_journal(str(journal_path))
+    rejects = [e for e in events if e["event"] == "params_reject"]
+    assert [r["staleness"] for r in rejects] == [1, 2]
+    assert rejects[-1]["escalate"] is True
+    (finding,) = [
+        e for e in events if e["event"] == "divergence" and e.get("kind") == "param_staleness_exhausted"
+    ]
+    assert finding["staleness"] == 2 and finding["budget"] == 1
+    (run_end,) = [e for e in events if e["event"] == "run_end"]
+    assert run_end["status"] == "halted"
+
+    best, _skipped = newest_verified_checkpoint(str(run_dir))
+    assert best is not None
+    saved = load_state(best)
+    # every saved param leaf is finite: the snapshot is the last-good state,
+    # not the NaN trainer params the fence escalated about
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(saved["agent"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # ... with the COUNTERS (and the file/manifest step) of the iteration the
+    # snapshot came from: iter 1 of 16 policy steps, not the halt iteration —
+    # resume never claims progress that never happened
+    assert saved["iter_num"] == 1 and saved["policy_step"] == 16
+    assert best.endswith("ckpt_16_0.ckpt")
+
+
+@pytest.mark.slow
+def test_chaos_slow_write_inflates_ckpt_accounting_not_the_run(run_cli):
+    """The ``slow_write`` fault stalls the async writer, not the loop: the
+    run completes, the fault is journaled, and the delayed write's
+    ``ckpt_end`` still lands (with its queued_s carrying the stall)."""
+    from pathlib import Path
+
+    run_cli(
+        *PPO_DECOUPLED_TINY,
+        "run_name=chaos_slow",
+        "algo.total_steps=48",
+        "checkpoint.every=16",
+        "checkpoint.save_last=True",
+        "diagnostics.resilience.chaos.schedule=[{iter: 1, fault: slow_write}]",
+        "diagnostics.resilience.chaos.slow_write_s=0.4",
+    )
+    run_dir = Path("logs") / "runs" / "ppo_decoupled" / "discrete_dummy" / "chaos_slow"
+    (journal_path,) = sorted(run_dir.rglob("journal.jsonl"))
+    events = read_journal(str(journal_path))
+    (fault,) = [e for e in events if e["event"] == "fault_injection"]
+    assert fault["kind"] == "slow_write" and fault["source"] == "chaos"
+    ends = [e for e in events if e["event"] == "ckpt_end"]
+    assert ends and all(e["status"] == "ok" for e in ends)
+    # the stalled write queued for at least the injected delay
+    assert max(e.get("queued_s", 0.0) for e in ends) >= 0.4
+    assert events[-1]["event"] == "run_end" and events[-1]["status"] == "completed"

@@ -1,0 +1,204 @@
+"""Coordinated multi-host snapshot units (ISSUE 14 tentpole pillar 3):
+manifest group fields, torn-snapshot resume skips (``incomplete_group``),
+single-process bit-identity, and the barrier/broadcast save protocol on a
+faked 2-rank runtime."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience.coordination import (
+    coordinated_save,
+    group_record,
+    group_status,
+    rank_shard_path,
+    shard_rank,
+)
+from sheeprl_tpu.resilience.manifest import (
+    newest_verified_checkpoint,
+    read_manifest,
+    resolve_resume_from,
+    save_verified_checkpoint,
+    drain_journal_events,
+)
+
+
+def _state(step: int, rank: int = 0):
+    return {"agent": {"w": np.full(4, step + rank, np.float32)}, "policy_step": step}
+
+
+def _write_group(ckpt_dir, step: int, world: int, ranks=None):
+    """Write one coordinated group (all ranks by default) and return the
+    rank-0 shard path."""
+    ranks = range(world) if ranks is None else ranks
+    base = ckpt_dir / f"ckpt_{step}_0.ckpt"
+    for rank in ranks:
+        shard = rank_shard_path(str(base), rank)
+        save_verified_checkpoint(
+            shard, _state(step, rank), step=step, group=group_record(world, rank, step)
+        )
+    return str(base)
+
+
+def test_rank_shard_path_follows_the_filename_convention(tmp_path):
+    assert rank_shard_path("logs/ckpt_128_0.ckpt", 3) == "logs/ckpt_128_3.ckpt"
+    assert rank_shard_path("logs/ckpt_128_2.ckpt", 0) == "logs/ckpt_128_0.ckpt"
+    # exotic names still shard without colliding
+    assert rank_shard_path("logs/final.ckpt", 2) == "logs/final.rank2.ckpt"
+    # ... and IDEMPOTENTLY: group_status derives siblings from a shard path,
+    # so an existing fallback marker is replaced, never stacked
+    assert rank_shard_path("logs/final.rank0.ckpt", 2) == "logs/final.rank2.ckpt"
+    assert rank_shard_path("logs/final.rank2.ckpt", 0) == "logs/final.rank0.ckpt"
+
+
+def test_exotic_name_groups_verify_end_to_end(tmp_path):
+    """A coordinated save under a non-conventional name (Runtime.save's own
+    docstring allows any producer) must still form a verifiable group."""
+    base = str(tmp_path / "last.ckpt")
+    for rank in range(2):
+        save_verified_checkpoint(
+            rank_shard_path(base, rank), _state(7, rank), step=7, group=group_record(2, rank, 7)
+        )
+    assert group_status(str(tmp_path / "last.rank0.ckpt")) == (True, "group_verified")
+    assert group_status(str(tmp_path / "last.rank1.ckpt")) == (True, "group_verified")
+
+
+def test_manifest_group_fields_land_and_single_process_is_bit_identical(tmp_path):
+    grouped = tmp_path / "ckpt_16_0.ckpt"
+    save_verified_checkpoint(str(grouped), _state(16), step=16, group=group_record(2, 0, 16))
+    entry = read_manifest(str(grouped))
+    assert entry["group"] == {"world_size": 2, "rank": 0, "group_step": 16}
+
+    plain = tmp_path / "ckpt_32_0.ckpt"
+    save_verified_checkpoint(str(plain), _state(32), step=32)
+    plain_entry = read_manifest(str(plain))
+    # single-process manifests carry NO group record: byte-identical format
+    assert "group" not in plain_entry
+    assert set(plain_entry) == {"format", "step", "bytes", "sha256", "fingerprint", "written_t", "tree"}
+    assert group_status(str(plain)) == (True, "ungrouped")
+    assert shard_rank(str(plain)) is None
+
+
+def test_group_status_detects_torn_groups(tmp_path):
+    complete = _write_group(tmp_path, 16, world=2)
+    assert group_status(complete) == (True, "group_verified")
+
+    # missing sibling shard
+    torn_missing = _write_group(tmp_path, 32, world=2, ranks=[0])
+    assert group_status(torn_missing) == (False, "incomplete_group")
+
+    # corrupt sibling shard
+    torn_corrupt = _write_group(tmp_path, 48, world=2)
+    (tmp_path / "ckpt_48_1.ckpt").write_bytes(b"truncated by the preemption")
+    assert group_status(torn_corrupt) == (False, "incomplete_group")
+
+    # sibling from a DIFFERENT group step (stale shard left by a dead rank)
+    torn_stale = _write_group(tmp_path, 64, world=2, ranks=[0])
+    shard = rank_shard_path(torn_stale, 1)
+    save_verified_checkpoint(shard, _state(63, 1), step=63, group=group_record(2, 1, 63))
+    assert group_status(torn_stale) == (False, "incomplete_group")
+
+
+def test_resume_selection_skips_torn_group_and_uses_previous_complete_one(tmp_path):
+    """The 2-rank acceptance scenario: a torn newest snapshot (one rank's
+    shard missing/corrupt) is skipped at resume with reason
+    ``incomplete_group`` and the previous complete group is selected."""
+    older = _write_group(tmp_path, 16, world=2)
+    _write_group(tmp_path, 32, world=2, ranks=[0])  # newest: rank 1 never landed
+
+    best, skipped = newest_verified_checkpoint(str(tmp_path))
+    assert best == older
+    assert {s["reason"] for s in skipped} == {"incomplete_group"}
+    assert skipped[0]["path"].endswith("ckpt_32_0.ckpt")
+
+    # the CLI resume path journals the same skip record
+    drain_journal_events()
+    resolved = resolve_resume_from(str(tmp_path))
+    assert resolved == older
+    queued = drain_journal_events()
+    assert ("ckpt_skipped", {"path": str(tmp_path / "ckpt_32_0.ckpt"), "reason": "incomplete_group"}) in queued
+
+
+def test_resume_selection_never_returns_a_nonzero_rank_shard(tmp_path):
+    _write_group(tmp_path, 16, world=2)
+    best, skipped = newest_verified_checkpoint(str(tmp_path))
+    assert best == str(tmp_path / "ckpt_16_0.ckpt")
+    # the rank-1 shard is selection-invisible, not corrupt: no skip record
+    assert skipped == []
+
+
+def test_keep_last_pruning_deletes_whole_groups_never_tears_them(tmp_path):
+    """File-count pruning would tear a coordinated group (one deleted shard
+    makes every survivor `incomplete_group`); pruning must count GROUPS."""
+    import os
+
+    from sheeprl_tpu.utils.checkpoint import CheckpointCallback
+
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    for i, step in enumerate((16, 32, 48)):
+        base = _write_group(ckpt_dir, step, world=3)
+        for rank in range(3):
+            shard = rank_shard_path(base, rank)
+            os.utime(shard, (1_000_000 + i, 1_000_000 + i))
+
+    CheckpointCallback(keep_last=2)._delete_old_checkpoints(ckpt_dir)
+    survivors = sorted(p.name for p in ckpt_dir.glob("*.ckpt"))
+    # keep_last=2 keeps the two newest GROUPS complete (6 files), drops the
+    # oldest group whole — no torn survivors
+    assert survivors == [f"ckpt_{s}_{r}.ckpt" for s in (32, 48) for r in range(3)]
+    for step in (32, 48):
+        assert group_status(str(ckpt_dir / f"ckpt_{step}_0.ckpt")) == (True, "group_verified")
+
+
+class FakeRuntime:
+    """2-process stand-in: in the test both "ranks" run in this process, so
+    barrier is a counter and broadcast returns rank-0's value verbatim."""
+
+    def __init__(self):
+        self.barriers = 0
+        self.broadcasts = []
+        self.diagnostics = None
+
+    def barrier(self):
+        self.barriers += 1
+
+    def broadcast(self, obj, src=0):
+        self.broadcasts.append(obj)
+        return obj
+
+
+def test_coordinated_save_protocol_on_a_faked_two_rank_world(tmp_path, monkeypatch):
+    import jax
+
+    runtime = FakeRuntime()
+    path = str(tmp_path / "ckpt_128_0.ckpt")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    for rank in (0, 1):
+        monkeypatch.setattr(jax, "process_index", lambda r=rank: r)
+        coordinated_save(runtime, path, _state(128, rank))
+
+    # entry + exit barrier per rank, one step broadcast per rank
+    assert runtime.barriers == 4
+    assert runtime.broadcasts == [128, 128]
+    assert group_status(path) == (True, "group_verified")
+    for rank in (0, 1):
+        entry = read_manifest(rank_shard_path(path, rank))
+        assert entry["group"] == {"world_size": 2, "rank": rank, "group_step": 128}
+    best, skipped = newest_verified_checkpoint(str(tmp_path))
+    assert best == path and skipped == []
+
+
+def test_runtime_load_prefers_own_shard_off_rank_zero(tmp_path, monkeypatch):
+    import jax
+
+    from sheeprl_tpu.parallel.runtime import Runtime
+
+    path = _write_group(tmp_path, 16, world=2)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    runtime = Runtime.__new__(Runtime)
+    state = Runtime.load(runtime, path)
+    # rank 1 loaded ITS shard (states differ per rank in _write_group)
+    np.testing.assert_array_equal(state["agent"]["w"], np.full(4, 17, np.float32))

@@ -1,0 +1,305 @@
+"""Last-good param fencing + quarantine/rollback units (ISSUE 14 tentpole
+pillars 1-2): deterministic gate verdicts on pre-fetched health stats,
+staleness-budget escalation, exact params+opt_state restoration, retry-budget
+exhaustion, and the facade-level halt-absorption contract."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience.isolation import IsolationHalt, IsolationMonitor
+
+
+def _monitor(**iso) -> IsolationMonitor:
+    cfg = {"diagnostics": {"resilience": {"isolation": dict(iso)}}}
+    return IsolationMonitor(cfg)
+
+
+def _opened(journal=None, **iso) -> IsolationMonitor:
+    monitor = _monitor(**iso)
+    events = journal if journal is not None else []
+    monitor.open(lambda kind, **fields: events.append({"event": kind, **fields}))
+    return monitor
+
+
+HEALTHY = {"grad_norm": 1.25, "update_norm": 0.01, "param_norm": 10.0}
+
+
+def test_knob_validation():
+    with pytest.raises(ValueError, match="max_staleness"):
+        _monitor(max_staleness=0)
+    with pytest.raises(ValueError, match="retry_budget"):
+        _monitor(retry_budget=-1)
+
+
+def test_gate_accepts_healthy_and_rejects_each_signal():
+    events = []
+    monitor = _opened(journal=events)
+    assert monitor.judge(1, 16, HEALTHY, nonfinite=0.0)
+    assert monitor.staleness == 0 and events == []
+
+    # in-graph nonfinite count wins over everything else
+    assert not monitor.judge(2, 32, HEALTHY, nonfinite=2.0)
+    assert events[-1]["event"] == "params_reject"
+    assert events[-1]["reason"] == "nonfinite_update"
+    assert events[-1]["staleness"] == 1 and events[-1]["budget"] == monitor.max_staleness
+
+    # a NaN fetched health norm
+    assert not monitor.judge(3, 48, {**HEALTHY, "grad_norm": float("nan")})
+    assert events[-1]["reason"] == "nonfinite:grad_norm"
+    assert monitor.staleness == 2
+
+    # an open learning-health anomaly
+    assert not monitor.judge(4, 64, HEALTHY, anomalies=["entropy_collapse"])
+    assert events[-1]["reason"] == "open_anomaly:entropy_collapse"
+
+    # recovery resets the staleness counter
+    assert monitor.judge(5, 80, HEALTHY)
+    assert monitor.staleness == 0
+
+
+def test_gate_anomaly_veto_is_configurable():
+    monitor = _opened(reject_on_anomaly=False)
+    assert monitor.judge(1, 16, HEALTHY, anomalies=["entropy_collapse"])
+
+
+def test_anomaly_rejections_fence_but_never_escalate():
+    """An open advisory anomaly may hold the player back indefinitely, but
+    only NON-FINITE rejections can exhaust the budget into a fatal halt."""
+    events = []
+    monitor = _opened(journal=events, max_staleness=2)
+    for iter_num in range(1, 7):
+        assert not monitor.judge(iter_num, iter_num * 16, HEALTHY, anomalies=["entropy_collapse"])
+    assert monitor.staleness == 6 and not monitor.halt_due
+    assert all(e["escalate"] is False for e in events)
+    # one nonfinite rejection past the budget DOES escalate
+    assert not monitor.judge(7, 112, HEALTHY, nonfinite=1.0)
+    assert monitor.halt_due and events[-1]["escalate"] is True
+
+
+def test_staleness_budget_escalates_once_exhausted():
+    events = []
+    synced = []
+    monitor = _monitor(max_staleness=2)
+    monitor.open(
+        lambda kind, **fields: events.append({"event": kind, **fields}),
+        lambda: synced.append(True),
+    )
+    bad = {**HEALTHY, "param_norm": float("inf")}
+    for iter_num in (1, 2):
+        assert not monitor.judge(iter_num, iter_num * 16, bad)
+        assert not monitor.halt_due
+        assert events[-1]["escalate"] is False
+    assert not monitor.judge(3, 48, bad)
+    assert monitor.halt_due
+    # the escalating rejection is marked and fsync'd
+    assert events[-1]["escalate"] is True and synced
+    # can_absorb is off while a halt is due — no rollback races the shutdown
+    assert not monitor.can_absorb()
+
+
+def test_rollback_restores_exact_params_and_opt_state():
+    events = []
+    monitor = _opened(journal=events, retry_budget=2)
+    params = {"dense": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    opt_state = {"mu": np.ones(3, np.float32)}
+    assert monitor.rollback(RuntimeError("x"), 1, 16) is None  # nothing snapshotted yet
+
+    monitor.refresh(1, params, opt_state)
+    golden_w = params["dense"]["w"].copy()
+    # the snapshot must not alias live storage: corrupt the live trees
+    params["dense"]["w"][:] = np.nan
+    opt_state["mu"][:] = -1.0
+
+    restored = monitor.rollback(RuntimeError("boom"), 2, 32)
+    assert restored is not None and restored["iter_num"] == 1
+    np.testing.assert_array_equal(restored["params"]["dense"]["w"], golden_w)
+    np.testing.assert_array_equal(restored["opt_state"]["mu"], np.ones(3, np.float32))
+    assert events[-1]["event"] == "rollback"
+    assert events[-1]["restored_iter"] == 1 and events[-1]["retries_left"] == 1
+
+    # double-buffered refresh: a newer snapshot supersedes, the old one is spare
+    params2 = {"dense": {"w": np.full((2, 3), 7.0, np.float32)}}
+    monitor.refresh(3, params2, opt_state)
+    restored2 = monitor.rollback(RuntimeError("again"), 4, 64)
+    assert restored2["iter_num"] == 3
+    np.testing.assert_array_equal(restored2["params"]["dense"]["w"], params2["dense"]["w"])
+
+    # budget of 2 is now spent: the next failure re-raises at the call site
+    assert not monitor.can_absorb()
+    assert monitor.rollback(RuntimeError("third"), 5, 80) is None
+    assert sum(1 for e in events if e["event"] == "rollback") == 2
+
+
+def test_refresh_every_amortizes_the_snapshot_fetch():
+    monitor = _opened(refresh_every=3)
+    with pytest.raises(ValueError, match="refresh_every"):
+        _monitor(refresh_every=0)
+    params = {"w": np.zeros(2, np.float32)}
+    opt = {"mu": np.zeros(2, np.float32)}
+    snapshots = []
+    for iter_num in range(1, 8):
+        monitor.refresh(iter_num, {"w": np.full(2, iter_num, np.float32)}, opt)
+        snapshots.append(monitor.last_good["iter_num"])
+    # first promotion always arms rollback; then every 3rd refreshes
+    assert snapshots == [1, 1, 1, 4, 4, 4, 7]
+    del params
+
+
+def test_disabled_gate_promotes_everything():
+    monitor = _opened(enabled=False)
+    assert monitor.judge(1, 16, {"grad_norm": float("nan")}, nonfinite=5.0)
+    assert monitor.interval_metrics() == {}
+
+
+def test_interval_metrics_only_after_gate_use():
+    monitor = _opened()
+    assert monitor.interval_metrics() == {}
+    monitor.judge(1, 16, HEALTHY)
+    assert monitor.interval_metrics() == {"Telemetry/param_staleness": 0.0}
+    monitor.judge(2, 32, HEALTHY, nonfinite=1.0)
+    assert monitor.interval_metrics() == {"Telemetry/param_staleness": 1.0}
+    assert monitor.counters() == {"params_rejected_total": 1, "rollbacks_total": 0}
+
+
+def test_facade_halt_is_not_closed_when_absorbable(tmp_path):
+    """`on_update` under sentinel policy=halt must leave the facade OPEN when
+    the decoupled loop is about to absorb the halt via rollback — and keep
+    today's close-then-raise when it cannot (no snapshot)."""
+    from sheeprl_tpu.diagnostics import Diagnostics, SentinelHalt
+
+    cfg = {
+        "diagnostics": {
+            "enabled": True,
+            "sentinel": {"enabled": True, "policy": "halt", "divergence": {"enabled": False}},
+        }
+    }
+    diag = Diagnostics(cfg).open(str(tmp_path))
+    try:
+        # no last-good snapshot yet -> not absorbable -> closed on halt
+        with pytest.raises(SentinelHalt):
+            diag.on_update(16, {"Loss/policy_loss": float("nan")}, nonfinite=1.0)
+        assert diag._closed
+    finally:
+        diag.close()
+
+    diag2 = Diagnostics(cfg).open(str(tmp_path / "second"))
+    try:
+        diag2.refresh_last_good(1, {"w": np.ones(2, np.float32)}, {"mu": np.zeros(2, np.float32)})
+        with pytest.raises(SentinelHalt) as exc_info:
+            diag2.on_update(32, {"Loss/policy_loss": float("nan")}, nonfinite=1.0)
+        assert not diag2._closed  # the loop's quarantine still has a live journal
+        restored = diag2.quarantine(exc_info.value, 2, 32)
+        assert restored is not None
+        np.testing.assert_array_equal(restored["params"]["w"], np.ones(2, np.float32))
+        rollback_lines = [
+            e for e in _read_journal(tmp_path / "second") if e.get("event") == "rollback"
+        ]
+        assert len(rollback_lines) == 1
+    finally:
+        diag2.close()
+
+
+def test_fence_halt_raises_isolation_halt_and_journals(tmp_path):
+    from sheeprl_tpu.diagnostics import Diagnostics
+
+    cfg = {
+        "diagnostics": {
+            "enabled": True,
+            "resilience": {"isolation": {"max_staleness": 1}},
+        }
+    }
+    diag = Diagnostics(cfg).open(str(tmp_path))
+    bad = {"grad_norm": float("nan")}
+    assert not diag.gate_promotion(1, 16, stats=bad)
+    assert not diag.fence_halt_due()
+    assert not diag.gate_promotion(2, 32, stats=bad)
+    assert diag.fence_halt_due()
+    with pytest.raises(IsolationHalt):
+        diag.on_fence_halt(32, 2, str(tmp_path / "ckpt_32_0.ckpt"))
+    events = _read_journal(tmp_path)
+    (finding,) = [
+        e for e in events if e.get("event") == "divergence" and e.get("kind") == "param_staleness_exhausted"
+    ]
+    assert finding["staleness"] == 2 and finding["budget"] == 1
+    assert events[-1]["event"] == "run_end" and events[-1]["status"] == "halted"
+
+
+def _read_journal(log_dir):
+    from sheeprl_tpu.diagnostics import read_journal
+
+    return read_journal(str(log_dir / "journal.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# monitor surfaces: journal panel, banner, /metrics export
+
+
+def test_stale_params_banner_fires_past_half_budget():
+    from sheeprl_tpu.diagnostics.report import stale_params_banner
+
+    assert stale_params_banner(None, 8) is None
+    assert stale_params_banner(4, None) is None
+    assert stale_params_banner(4, 8) is None  # exactly half: quiet
+    banner = stale_params_banner(5, 8)
+    assert banner is not None and "!! STALE-PARAMS" in banner and "5 trainer updates behind" in banner
+
+
+def test_isolation_status_lines_panel_and_live_banner():
+    from sheeprl_tpu.diagnostics.report import isolation_status_lines, status_block
+
+    events = [
+        {"t": 1.0, "event": "run_start", "algo": "ppo_decoupled", "env": "d", "seed": 1},
+        {"t": 2.0, "event": "params_reject", "reason": "nonfinite_update", "iter_num": 2, "staleness": 1, "budget": 4},
+        {"t": 3.0, "event": "rollback", "iter_num": 2, "restored_iter": 1, "retries_left": 2, "budget": 3, "error": "SentinelHalt('x')"},
+        {"t": 4.0, "event": "params_reject", "reason": "nonfinite:grad_norm", "iter_num": 3, "staleness": 3, "budget": 4},
+        {"t": 5.0, "event": "metrics", "step": 48, "metrics": {"Telemetry/param_staleness": 3.0}},
+    ]
+    lines = isolation_status_lines(events, live=True)
+    assert lines[0].startswith("fencing ")
+    assert "2 rejects" in lines[0] and "1 rollbacks" in lines[0] and "staleness 3" in lines[0]
+    assert "nonfinite:grad_norm" in lines[0] and "2 retries left" in lines[0]
+    assert any("!! STALE-PARAMS" in line for line in lines)
+    # post-mortem mode states the facts without shouting
+    assert not any("!! STALE-PARAMS" in line for line in isolation_status_lines(events, live=False))
+    # an inactive gate grows no panel
+    assert isolation_status_lines([{"t": 1.0, "event": "metrics", "metrics": {}}]) == []
+    # and the full status block carries the panel
+    assert "fencing " in status_block(events)
+
+
+def test_event_lines_for_reject_and_rollback():
+    from sheeprl_tpu.diagnostics.report import format_event_line
+
+    reject = format_event_line(
+        {"t": 1.0, "event": "params_reject", "reason": "nonfinite_update", "iter_num": 2, "staleness": 1, "budget": 8}
+    )
+    assert "params_reject" in reject and "staleness 1/8" in reject and "last-good params" in reject
+    escalated = format_event_line(
+        {"t": 1.0, "event": "params_reject", "reason": "nonfinite_update", "iter_num": 9, "staleness": 9, "budget": 8, "escalate": True}
+    )
+    assert "!! PARAMS-REJ" in escalated
+    rollback = format_event_line(
+        {"t": 1.0, "event": "rollback", "iter_num": 2, "restored_iter": 1, "retries_left": 2, "budget": 3, "error": "boom"}
+    )
+    assert "!! ROLLBACK" in rollback and "restored iter-1" in rollback and "2/3 retries left" in rollback
+
+
+def test_metrics_endpoint_exports_fencing_series():
+    from sheeprl_tpu.diagnostics.metrics_server import render_prometheus
+    from sheeprl_tpu.resilience.monitor import ResilienceMonitor
+
+    monitor = ResilienceMonitor(
+        {"diagnostics": {"resilience": {"async_checkpoint": False, "preempt": {"enabled": False}}}}
+    )
+    monitor.open(None, None)
+    try:
+        monitor.isolation.judge(1, 16, {"grad_norm": float("nan")})
+        text = render_prometheus(monitor.snapshot())
+    finally:
+        monitor.close()
+    assert "sheeprl_param_staleness 1" in text
+    assert "sheeprl_param_staleness_budget 8" in text
+    assert "sheeprl_params_rejected_total 1" in text
+    assert "sheeprl_rollbacks_total 0" in text

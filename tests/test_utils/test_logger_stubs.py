@@ -1,0 +1,117 @@
+"""W&B / MLflow logger classes against stubbed backend modules (VERDICT item
+6): the backends are not installed in the image, so — the way
+``test_mlflow_manager.py`` stubs the MLflow client — fake ``wandb`` /
+``mlflow`` modules exercise the construction, metric/hyperparam logging and
+finalize paths that used to hide behind ``# pragma: no cover``."""
+
+from __future__ import annotations
+
+import sys
+import types
+from types import SimpleNamespace
+
+import pytest
+
+import sheeprl_tpu.utils.logger as logger_mod
+from sheeprl_tpu.utils.logger import MLFlowLogger, WandbLogger
+
+
+class FakeWandbRun:
+    def __init__(self):
+        self.logged = []
+        self.config = SimpleNamespace(updates=[], update=lambda d, **kw: self.config.updates.append((d, kw)))
+        self.finished = False
+
+    def log(self, metrics, step=None):
+        self.logged.append((dict(metrics), step))
+
+    def finish(self):
+        self.finished = True
+
+
+@pytest.fixture()
+def fake_wandb(monkeypatch):
+    module = types.ModuleType("wandb")
+    module.inits = []
+
+    def init(**kwargs):
+        module.inits.append(kwargs)
+        module.run = FakeWandbRun()
+        return module.run
+
+    module.init = init
+    monkeypatch.setitem(sys.modules, "wandb", module)
+    monkeypatch.setattr(logger_mod, "_IS_WANDB_AVAILABLE", True)
+    return module
+
+
+@pytest.fixture()
+def fake_mlflow(monkeypatch):
+    module = types.ModuleType("mlflow")
+    module.calls = []
+    module.set_tracking_uri = lambda uri: module.calls.append(("set_tracking_uri", uri))
+    module.set_experiment = lambda name: module.calls.append(("set_experiment", name))
+    module.start_run = lambda **kw: module.calls.append(("start_run", kw)) or SimpleNamespace(info=SimpleNamespace(run_id="r1"))
+    module.log_metrics = lambda metrics, step=None: module.calls.append(("log_metrics", dict(metrics), step))
+    module.log_params = lambda params: module.calls.append(("log_params", dict(params)))
+    module.end_run = lambda: module.calls.append(("end_run",))
+    monkeypatch.setitem(sys.modules, "mlflow", module)
+    monkeypatch.setattr(logger_mod, "_IS_MLFLOW_AVAILABLE", True)
+    return module
+
+
+def test_wandb_logger_logs_hparams_metrics_and_finalizes(fake_wandb, tmp_path):
+    logger = WandbLogger(project="proj", save_dir=str(tmp_path), name="run1")
+    assert fake_wandb.inits == [{"project": "proj", "dir": str(tmp_path), "name": "run1"}]
+    assert logger.log_dir == str(tmp_path) and logger.name == "wandb"
+
+    logger.log_metrics({"Loss/policy_loss": 1.5}, step=7)
+    assert fake_wandb.run.logged == [({"Loss/policy_loss": 1.5}, 7)]
+
+    class Cfg(dict):
+        def as_dict(self):
+            return dict(self)
+
+    logger.log_hyperparams(Cfg({"algo": {"lr": 3e-4}}))
+    (payload, kwargs) = fake_wandb.run.config.updates[0]
+    assert payload == {"algo": {"lr": 3e-4}} and kwargs == {"allow_val_change": True}
+
+    logger.finalize()
+    assert fake_wandb.run.finished
+
+
+def test_wandb_logger_raises_without_backend(monkeypatch):
+    monkeypatch.setattr(logger_mod, "_IS_WANDB_AVAILABLE", False)
+    with pytest.raises(ModuleNotFoundError, match="wandb is not installed"):
+        WandbLogger()
+
+
+def test_mlflow_logger_logs_flat_params_and_metrics(fake_mlflow, monkeypatch):
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", "http://fallback")
+    logger = MLFlowLogger(experiment_name="exp1", tracking_uri="http://tracking")
+    assert ("set_tracking_uri", "http://tracking") in fake_mlflow.calls
+    assert ("set_experiment", "exp1") in fake_mlflow.calls
+    assert any(c[0] == "start_run" for c in fake_mlflow.calls)
+
+    logger.log_metrics({"Loss/value_loss": 2, "Rewards/rew_avg": 3.5}, step=11)
+    assert ("log_metrics", {"Loss/value_loss": 2.0, "Rewards/rew_avg": 3.5}, 11) in fake_mlflow.calls
+
+    logger.log_hyperparams({"algo": {"optimizer": {"lr": 1e-3}}, "seed": 5})
+    (_, flat) = next(c for c in fake_mlflow.calls if c[0] == "log_params")
+    # nested dicts flatten into dotted keys (the MLflow params convention)
+    assert flat == {"algo.optimizer.lr": 1e-3, "seed": 5}
+
+    logger.finalize()
+    assert ("end_run",) in fake_mlflow.calls
+
+
+def test_mlflow_logger_tracking_uri_falls_back_to_env(fake_mlflow, monkeypatch):
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", "http://from-env")
+    MLFlowLogger(experiment_name="exp2")
+    assert ("set_tracking_uri", "http://from-env") in fake_mlflow.calls
+
+
+def test_mlflow_logger_raises_without_backend(monkeypatch):
+    monkeypatch.setattr(logger_mod, "_IS_MLFLOW_AVAILABLE", False)
+    with pytest.raises(ModuleNotFoundError, match="mlflow is not installed"):
+        MLFlowLogger()

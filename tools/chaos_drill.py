@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Chaos drill: run a scripted fault schedule through the real CLI and
+assert the recovery invariants (ROADMAP item 4 robustness).
+
+Thin launcher over ``sheeprl_tpu.resilience.chaos`` (same flags), runnable
+straight from a checkout:
+
+    python tools/chaos_drill.py --drill nan_grads
+    python tools/chaos_drill.py --schedule '[{iter: 2, fault: nan_grads}, {iter: 4, fault: slow_write}]'
+    python tools/chaos_drill.py --drill trainer_exception -- exp=sac_decoupled env=dummy ...
+
+Faults: ``nan_grads`` (poisoned train batch → ``params_reject`` →
+``rollback`` → run completes on last-good params), ``trainer_exception``
+(quarantine/rollback without NaNs), ``slow_write`` (checkpoint writer
+stall), ``preempt`` (emergency snapshot → exit 75).  Without overrides a
+tiny decoupled PPO run on the dummy env is used.  Exit 0 = every recovery
+invariant held.  See ``howto/resilience.md``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# runnable straight from a checkout: tools/ is not a package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.resilience.chaos import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

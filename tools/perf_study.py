@@ -9,6 +9,8 @@ Prints one JSON line per experiment:
 Usage: python tools/perf_study.py [--sizes S,XL] [--batches 16,32,64]
        python tools/perf_study.py --unroll-ab   # interleaved unroll 1-vs-8 pair
        python tools/perf_study.py --xl-levers   # pallas/unroll vs base at XL
+       python tools/perf_study.py --decoupled-ab  # coupled-vs-decoupled PPO pair
+                                                  # on the virtual 8-device mesh
 """
 
 from __future__ import annotations
@@ -331,6 +333,19 @@ def main() -> None:
     batches = [int(b) for b in os.environ.get("PERF_BATCHES", "16,32,64").split(",")]
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
     phases = os.environ.get("PERF_PHASES", "0") == "1"
+
+    # decoupled-topology overhead pair (ISSUE 14 / VERDICT item 7): coupled@7
+    # vs decoupled@1+7 dryrun-style PPO on the virtual 8-device CPU mesh —
+    # subprocesses, no accelerator needed, so the steady-state scatter /
+    # params-hop overhead line lands on dead-tunnel rounds too
+    if os.environ.get("PERF_DECOUPLED_AB", "0") == "1" or "--decoupled-ab" in sys.argv:
+        from bench import measure_decoupled
+
+        print(
+            json.dumps({"experiment": "ppo_decoupled_ab_virtual8", **measure_decoupled()}),
+            flush=True,
+        )
+        return
 
     # env pipeline host-time split + many-env scaling first: neither needs an
     # accelerator, so both land even when the probe below aborts the chip

@@ -55,6 +55,7 @@ from sheeprl_tpu.diagnostics.report import (  # noqa: E402
     format_bytes,
     format_event_line,
     no_recent_ckpt_banner,
+    stale_params_banner,
     status_block,
 )
 
@@ -207,6 +208,23 @@ def endpoint_status(url: str) -> str:
         banner = no_recent_ckpt_banner(ckpt_age, ckpt_interval)
         if banner is not None:
             lines.append(banner)
+    staleness = metrics.get("sheeprl_param_staleness")
+    if staleness is not None:
+        fence_parts = [f"staleness {staleness:g}"]
+        budget = metrics.get("sheeprl_param_staleness_budget")
+        if budget is not None:
+            fence_parts[0] += f"/{budget:g}"
+        for key, label in (
+            ("sheeprl_params_rejected_total", "rejects"),
+            ("sheeprl_rollbacks_total", "rollbacks"),
+        ):
+            value = metrics.get(key)
+            if value is not None:
+                fence_parts.append(f"{value:g} {label}")
+        lines.append("fencing " + " · ".join(fence_parts))
+        banner = stale_params_banner(staleness, budget)
+        if banner is not None:
+            lines.append(banner)
     active_anomalies = metrics.get("sheeprl_health_anomalies")
     if active_anomalies:
         info = metrics["_labels"].get("sheeprl_run_info") or []
@@ -285,6 +303,8 @@ def endpoint_status(url: str) -> str:
         ("sheeprl_health_anomalies_total", "health anomalies"),
         ("sheeprl_ckpts_written_total", "ckpts written"),
         ("sheeprl_ckpt_failures_total", "ckpt failures"),
+        ("sheeprl_params_rejected_total", "params rejected"),
+        ("sheeprl_rollbacks_total", "rollbacks"),
         ("sheeprl_restarts_total", "restarts"),
     ):
         value = metrics.get(key)
