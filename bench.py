@@ -864,6 +864,170 @@ def measure_learn_health(total_steps: int = 96, timeout_s: float = 240.0):
     }
 
 
+def measure_offline(
+    rows: int = 4096,
+    obs_dim: int = 16,
+    batch: int = 256,
+    read_batches: int = 40,
+    drill: bool = True,
+    drill_timeout_s: float = 420.0,
+):
+    """Offline-RL block (ISSUE 15), always-lands: dataset read throughput
+    with the host-prefetch thread off vs on, plus offline grad-steps/s
+    through the real env-free CLI on the CPU fallback.
+
+    * ``read_sps`` — a synthetic in-memory-sized dataset (``rows`` SAC-shaped
+      transitions, sharded) streamed as ``read_batches`` flat batches of
+      ``batch`` rows by the deterministic loader, prefetch 0 vs 2.  The pure
+      read pair has no device step to hide behind, so prefetch can only add
+      queue-handoff overhead here (speedup <= 1 is expected); the drill's
+      ``dataset_read_sps`` below is the overlapped number that matters.  The
+      batch *sequence* is bit-identical either way (pinned by
+      tests/test_offline/);
+    * ``drill`` — a tiny SAC collect → ``export_run_dir`` → offline train
+      (``algo.offline.enabled=true``, CQL armed) in CPU subprocesses, the
+      grad-steps/s sourced from the offline run's own journal
+      (``Time/sps_train`` at the last metric interval) — the D4RL-style
+      workload measured end-to-end, not as a microbench.
+    """
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+    from sheeprl_tpu.data.datasets import OfflineDataset
+    from sheeprl_tpu.offline.export import export_buffer, export_run_dir
+
+    out: dict = {"rows": int(rows), "batch": int(batch)}
+    rng = np.random.default_rng(0)
+    tmp_root = tempfile.mkdtemp(prefix="bench_offline_")
+    try:
+        rb = ReplayBuffer(rows, 1, obs_keys=("observations",))
+        chunk = 256
+        for start in range(0, rows, chunk):
+            n = min(chunk, rows - start)
+            rb.add(
+                {
+                    "observations": rng.standard_normal((n, 1, obs_dim)).astype(np.float32),
+                    "next_observations": rng.standard_normal((n, 1, obs_dim)).astype(np.float32),
+                    "actions": rng.standard_normal((n, 1, 4)).astype(np.float32),
+                    "rewards": rng.standard_normal((n, 1, 1)).astype(np.float32),
+                    "terminated": np.zeros((n, 1, 1), np.float32),
+                    "truncated": np.zeros((n, 1, 1), np.float32),
+                }
+            )
+        export_buffer(rb, os.path.join(tmp_root, "ds"), shard_rows=1024)
+        ds = OfflineDataset(os.path.join(tmp_root, "ds"), deep_verify=False)
+        for prefetch, label in ((0, "read_sps_no_prefetch"), (2, "read_sps_prefetch")):
+            it = ds.batches(batch, seed=1, prefetch=prefetch)
+            next(it)  # warm the shard cache / spin the thread up
+            t0 = time.perf_counter()
+            for _ in range(int(read_batches)):
+                next(it)
+            out[label] = round(int(read_batches) * batch / (time.perf_counter() - t0), 1)
+        if out["read_sps_no_prefetch"] > 0:
+            out["prefetch_speedup"] = round(
+                out["read_sps_prefetch"] / out["read_sps_no_prefetch"], 3
+            )
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)
+    if not drill:
+        return out
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    common = [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "buffer.size=128",
+        "metric.log_level=1",
+        "metric.log_every=1",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "algo.per_rank_batch_size=16",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        "checkpoint.save_last=True",
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo_root, "sheeprl.py"),
+                *common,
+                "algo.total_steps=64",
+                "algo.learning_starts=1000",  # prefill-only collect
+                "buffer.checkpoint=True",
+                "run_name=bench_collect",
+            ],
+            cwd=td,
+            env=env,
+            check=True,
+            timeout=drill_timeout_s,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        collect_dir = Path(td) / "logs" / "runs" / "sac" / "continuous_dummy" / "bench_collect"
+        exported = export_run_dir(str(collect_dir), shard_rows=1024)
+        out["drill_dataset_rows"] = exported["rows"]
+        subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo_root, "sheeprl.py"),
+                *common,
+                "algo.total_steps=96",
+                "run_name=bench_offline",
+                "algo.offline.enabled=true",
+                f"algo.offline.dataset_dir={exported['path']}",
+                "algo.offline.grad_steps_per_iter=4",  # 16x4=64 rows/draw == the collected set
+                "algo.offline.cql_alpha=0.5",
+            ],
+            cwd=td,
+            env=env,
+            check=True,
+            timeout=drill_timeout_s,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        from sheeprl_tpu.diagnostics.journal import find_journal, read_journal
+
+        journal = find_journal(str(collect_dir.parent / "bench_offline"))
+        if journal is None:
+            raise RuntimeError("offline drill run left no journal")
+        events = read_journal(journal)
+        metrics_events = [e for e in events if e.get("event") == "metrics"]
+        last = (metrics_events[-1].get("metrics") or {}) if metrics_events else {}
+        out["drill_grad_steps_per_sec"] = (
+            round(float(last["Time/sps_train"]), 3)
+            if isinstance(last.get("Time/sps_train"), (int, float))
+            else None
+        )
+        out["drill_dataset_read_sps"] = (
+            round(float(last["Telemetry/dataset_read_sps"]), 1)
+            if isinstance(last.get("Telemetry/dataset_read_sps"), (int, float))
+            else None
+        )
+        losses = [
+            last.get(k)
+            for k in ("Loss/value_loss", "Loss/policy_loss", "Loss/alpha_loss")
+            if isinstance(last.get(k), (int, float))
+        ]
+        out["drill_losses_finite"] = bool(losses) and all(np.isfinite(v) for v in losses)
+        out["drill_shards_skipped"] = sum(
+            1 for e in events if e.get("event") == "dataset_shard_skipped"
+        )
+        out["workload"] = "sac offline, batch 16 x 4 grad-steps/iter, cql_alpha 0.5, CPU drill"
+    return out
+
+
 def measure_recovery(
     state_mb: float = 32.0,
     interval_iters: int = 12,
@@ -1442,6 +1606,12 @@ def _run_cpu_fallback(record: dict, precision: str) -> None:
         record["decoupled"] = measure_decoupled()
     except Exception as err:  # noqa: BLE001
         record.setdefault("stage_errors", {})["decoupled"] = repr(err)
+    # offline-RL block (ISSUE 15): loader read-sps prefetch pair + the
+    # env-free grad-steps/s drill — CPU-native by design, lands here too
+    try:
+        record["offline"] = measure_offline()
+    except Exception as err:  # noqa: BLE001
+        record.setdefault("stage_errors", {})["offline"] = repr(err)
 
 
 def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
@@ -1575,6 +1745,15 @@ def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
     if decoupled:
         record["decoupled"] = decoupled
 
+    # offline-RL block (ISSUE 15): loader read throughput (prefetch off/on)
+    # + the env-free SAC drill's grad-steps/s from its own journal — CPU
+    # subprocesses by design, so chip rounds carry the same numbers.  est
+    # covers the true worst case: two children, each bounded by its own
+    # 420 s timeout (the decoupled-stage lesson)
+    offline = stage("offline", 860, measure_offline)
+    if offline:
+        record["offline"] = offline
+
 
 def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
@@ -1622,6 +1801,11 @@ def main() -> None:
         # (measure_decoupled) — the scatter/params-hop overhead ratio.  Null
         # when the stage was skipped or failed.
         "decoupled": None,
+        # offline RL (ISSUE 15): dataset read-sps with the prefetch thread
+        # off vs on, plus the env-free SAC drill's grad-steps/s and live
+        # dataset_read_sps from its own journal (measure_offline).  Null when
+        # the stage was skipped or failed.
+        "offline": None,
         # CPU-fallback regression floor (VERDICT item 5): value vs the pinned
         # conservative CPU floor, with a contention-variance caveat.  Null on
         # chip rounds (the fallback path fills it).

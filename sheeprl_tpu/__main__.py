@@ -1,5 +1,6 @@
 """``python -m sheeprl_tpu`` → train CLI (reference sheeprl/__main__.py);
-``python -m sheeprl_tpu serve checkpoint_path=...`` → the policy server."""
+``python -m sheeprl_tpu serve checkpoint_path=...`` → the policy server;
+``python -m sheeprl_tpu export <run dir>`` → the run-dir dataset converter."""
 
 import sys
 
@@ -8,5 +9,9 @@ from sheeprl_tpu.cli import run, serve
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serve":
         serve(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "export":
+        from sheeprl_tpu.offline.export import main as export_main
+
+        sys.exit(export_main(sys.argv[2:]))
     else:
         run()

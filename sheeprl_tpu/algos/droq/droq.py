@@ -25,7 +25,7 @@ import optax
 
 from sheeprl_tpu.algos.droq.agent import build_agent
 from sheeprl_tpu.algos.droq.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
-from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.loss import conservative_q_penalty, entropy_loss, policy_loss
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.slab import step_slab
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -44,11 +44,25 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: floa
     cdt = compute_dtype_of(cfg)
     tau = cfg.algo.tau
     gamma = cfg.algo.gamma
+    # conservative Q penalty (offline mode, howto/offline_rl.md): trace-time
+    # constant — the cql_alpha=0 graph is bit-identical to the online step
+    offline_cfg = cfg.algo.get("offline") or {}
+    cql_alpha = float(offline_cfg.get("cql_alpha", 0.0) or 0.0)
+    cql_samples = int(offline_cfg.get("cql_samples", 4) or 4)
+    act_low = np.asarray(actor_def.action_low, np.float32).reshape(-1)
+    act_high = np.asarray(actor_def.action_high, np.float32).reshape(-1)
+    if cql_alpha > 0 and not (np.isfinite(act_low).all() and np.isfinite(act_high).all()):
+        raise ValueError(
+            "algo.offline.cql_alpha > 0 needs finite action bounds for its uniform "
+            "action proposals (set algo.offline.action_low/high)"
+        )
 
     def one_step(carry, inp):
         params, opt_states = carry
         batch, actor_batch, key = inp
         key = fold_key(key, axis)
+        if cql_alpha > 0:
+            key, k_cql = jax.random.split(key)
         k_next, k_drop, k_actor, k_drop2 = jax.random.split(key, 4)
         obs_c = cast_floating(batch["observations"], cdt)
         next_obs_c = cast_floating(batch["next_observations"], cdt)
@@ -76,7 +90,23 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, target_entropy: floa
                 False,
                 rngs={"dropout": k_drop},
             ).astype(jnp.float32)
-            return jnp.sum(jnp.mean((qf_values - next_qf_value) ** 2, axis=tuple(range(qf_values.ndim - 1))))
+            loss = jnp.sum(jnp.mean((qf_values - next_qf_value) ** 2, axis=tuple(range(qf_values.ndim - 1))))
+            if cql_alpha > 0:
+                # proposals take the deterministic critic pass (no dropout
+                # rng needed for the penalty term)
+                actor_c = cast_floating(params["actor"], cdt)
+                critic_c = cast_floating(critic_params, cdt)
+                loss = loss + cql_alpha * conservative_q_penalty(
+                    k_cql,
+                    obs_c,
+                    qf_values,
+                    lambda o, k: actor_def.apply(actor_c, o, k, method="sample_and_log_prob"),
+                    lambda o, a: critic_def.apply(critic_c, o, a, True),
+                    act_low,
+                    act_high,
+                    cql_samples,
+                )
+            return loss
 
         qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
         qf_grads = pmean_tree(qf_grads, axis)

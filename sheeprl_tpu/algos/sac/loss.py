@@ -21,3 +21,41 @@ def policy_loss(alpha: jax.Array, logprobs: jax.Array, min_qf_values: jax.Array)
 def entropy_loss(log_alpha: jax.Array, logprobs: jax.Array, target_entropy: float) -> jax.Array:
     """Automatic entropy-coefficient loss (reference loss.py:27-30)."""
     return jnp.mean(-log_alpha * (jax.lax.stop_gradient(logprobs) + target_entropy))
+
+
+def conservative_q_penalty(
+    key: jax.Array,
+    obs_c: jax.Array,
+    qf_values: jax.Array,
+    actor_apply,
+    critic_apply,
+    act_low,
+    act_high,
+    n_samples: int,
+) -> jax.Array:
+    """Simplified CQL(H) term shared by the SAC and DroQ offline-mode critic
+    losses (howto/offline_rl.md): logsumexp of Q over ``n_samples`` uniform +
+    ``n_samples`` fresh policy action proposals minus the dataset Q — pushes
+    Q down on out-of-distribution actions, up on the data's.
+
+    ``actor_apply(obs, key) -> (actions, logprobs)`` and
+    ``critic_apply(obs, actions) -> q`` close over their (already
+    compute-dtype-cast) params; ``qf_values`` is the fp32 dataset Q the
+    caller already computed, so no reduction is duplicated.
+    """
+    k_unif, k_pol = jax.random.split(key)
+    rand_actions = jax.random.uniform(
+        k_unif,
+        (int(n_samples), obs_c.shape[0], jnp.asarray(act_low).shape[0]),
+        minval=jnp.asarray(act_low),
+        maxval=jnp.asarray(act_high),
+        dtype=jnp.float32,
+    )
+    pol_actions, _ = jax.vmap(lambda k: actor_apply(obs_c, k))(
+        jax.random.split(k_pol, int(n_samples))
+    )
+    proposals = jnp.concatenate(
+        [rand_actions.astype(obs_c.dtype), jax.lax.stop_gradient(pol_actions)], axis=0
+    )
+    q_prop = jax.vmap(lambda a: critic_apply(obs_c, a))(proposals).astype(jnp.float32)
+    return jnp.mean(jax.scipy.special.logsumexp(q_prop, axis=0) - qf_values)

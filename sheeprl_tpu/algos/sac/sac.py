@@ -23,7 +23,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.sac.agent import build_agent
-from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.loss import conservative_q_penalty, critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -62,6 +62,19 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
     distributed = world > 1
     tau = cfg.algo.tau
     cdt = compute_dtype_of(cfg)
+    # conservative Q penalty (offline mode, howto/offline_rl.md): a
+    # trace-time constant — cql_alpha=0 (the default, and every online run)
+    # leaves the compiled graph bit-identical to the pre-offline step
+    offline_cfg = cfg.algo.get("offline") or {}
+    cql_alpha = float(offline_cfg.get("cql_alpha", 0.0) or 0.0)
+    cql_samples = int(offline_cfg.get("cql_samples", 4) or 4)
+    act_low = np.asarray(actor_def.action_low, np.float32).reshape(-1)
+    act_high = np.asarray(actor_def.action_high, np.float32).reshape(-1)
+    if cql_alpha > 0 and not (np.isfinite(act_low).all() and np.isfinite(act_high).all()):
+        raise ValueError(
+            "algo.offline.cql_alpha > 0 needs finite action bounds for its uniform "
+            "action proposals (set algo.offline.action_low/high)"
+        )
 
     def one_step(carry, inp):
         params, opt_states = carry
@@ -75,6 +88,10 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
         # network inputs in the compute dtype; TD targets stay fp32
         obs_c = cast_floating(batch["observations"], cdt)
         next_obs_c = cast_floating(batch["next_observations"], cdt)
+        # the cql key is split ONLY when the penalty is armed so the
+        # cql_alpha=0 graph (and its RNG stream) stays bit-identical
+        if cql_alpha > 0:
+            key, cql_key = jax.random.split(key)
 
         # --- critic update (reference sac.py:45-53) -----------------------
         def qf_loss_fn(critic_params):
@@ -93,7 +110,21 @@ def make_train_step(actor_def, critic_def, optimizers, cfg, mesh, target_entropy
             qf_values = critic_def.apply(
                 cast_floating(critic_params, cdt), obs_c, cast_floating(batch["actions"], cdt)
             ).astype(jnp.float32)
-            return critic_loss(qf_values, next_qf_value, cfg.algo.critic.n)
+            loss = critic_loss(qf_values, next_qf_value, cfg.algo.critic.n)
+            if cql_alpha > 0:
+                actor_c = cast_floating(params["actor"], cdt)
+                critic_c = cast_floating(critic_params, cdt)
+                loss = loss + cql_alpha * conservative_q_penalty(
+                    cql_key,
+                    obs_c,
+                    qf_values,
+                    lambda o, k: actor_def.apply(actor_c, o, k, method="sample_and_log_prob"),
+                    lambda o, a: critic_def.apply(critic_c, o, a),
+                    act_low,
+                    act_high,
+                    cql_samples,
+                )
+            return loss
 
         qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
         if distributed:

@@ -75,22 +75,27 @@ def resume_from_checkpoint(cfg: dotdict, overrides: Sequence[str] = ()) -> dotdi
     # `diagnostics` and `env` are also overridable — a resumed run must be
     # able to e.g. raise a stall threshold, point at a new compilation-cache
     # dir, or retune env host knobs (num_envs, capture_video, executor) —
-    # but ONLY the dotted keys the user explicitly passed: the env identity
-    # stays pinned by the env.id equality check above, and everything the
-    # user did not mention keeps its archived value
+    # and so is `algo.offline`, so a collected run can be resumed straight
+    # into offline fine-tuning on its own exported dataset
+    # (howto/offline_rl.md) — but ONLY the dotted keys the user explicitly
+    # passed: the env/algo identity stays pinned by the env.id / algo.name
+    # equality checks above, and everything the user did not mention keeps
+    # its archived value
     from sheeprl_tpu.config import deep_merge, yaml_load
 
     explicit: Dict[str, Any] = {}
     for ov in overrides:
         key, _, value = ov.partition("=")
         key = key.lstrip("+~")
-        if key.split(".", 1)[0] not in ("env", "diagnostics"):
+        offline_key = key == "algo.offline" or key.startswith("algo.offline.")
+        if key.split(".", 1)[0] not in ("env", "diagnostics") and not offline_key:
             continue
-        if "." in key:
+        if "." in key and key != "algo.offline":
             explicit[key] = yaml_load(value) if value != "" else None
         else:
-            # group swap (env=atari): take the whole freshly composed block
-            explicit[key] = cfg.get(key)
+            # group swap (env=atari / algo.offline={...}): take the whole
+            # freshly composed block
+            explicit[key] = cfg.get(key) if "." not in key else yaml_load(value)
     if explicit:
         deep_merge(merged, dotdict(nest_dotted(explicit)))
     merged.checkpoint.resume_from = str(ckpt_path)
@@ -271,6 +276,54 @@ def check_configs(cfg: dotdict) -> None:
                     f"algo.rssm_chunk_burn_in ({burn_in}) must be < the chunk length "
                     f"({seq_len // rssm_chunks} = per_rank_sequence_length / rssm_chunks)"
                 )
+    # offline training mode (howto/offline_rl.md): fail at compose time, not
+    # after the log dir exists — the mode swaps the whole entrypoint
+    offline_cfg = cfg.algo.get("offline") or {}
+    if offline_cfg.get("enabled"):
+        # literal set (not an import) so config validation never pays the
+        # offline subsystem's jax imports
+        supported = ("sac", "droq", "dreamer_v3")
+        if algo_name not in supported:
+            raise ValueError(
+                f"algo.offline.enabled=true supports {list(supported)}, got algo.name={algo_name!r}"
+            )
+        if not offline_cfg.get("dataset_dir"):
+            raise ValueError(
+                "algo.offline.enabled=true requires algo.offline.dataset_dir "
+                "(an exported dataset — see sheeprl-export / howto/offline_rl.md)"
+            )
+        if float(offline_cfg.get("cql_alpha", 0.0) or 0.0) < 0:
+            raise ValueError(
+                f"algo.offline.cql_alpha must be >= 0, got {offline_cfg.get('cql_alpha')!r}"
+            )
+        cql_samples = offline_cfg.get("cql_samples")
+        if cql_samples is not None and int(cql_samples) < 1:
+            raise ValueError(f"algo.offline.cql_samples must be >= 1, got {cql_samples!r}")
+        grad_steps = offline_cfg.get("grad_steps_per_iter")
+        if grad_steps is not None and int(grad_steps) < 1:
+            raise ValueError(
+                f"algo.offline.grad_steps_per_iter must be >= 1, got {grad_steps!r}"
+            )
+        if int(offline_cfg.get("prefetch", 2) or 0) < 0:
+            raise ValueError(
+                f"algo.offline.prefetch must be >= 0 (0 disables the prefetch thread), "
+                f"got {offline_cfg.get('prefetch')!r}"
+            )
+        seq = offline_cfg.get("sequence_length")
+        if seq is not None and int(seq) < 1:
+            raise ValueError(f"algo.offline.sequence_length must be >= 1 or null, got {seq!r}")
+        if entry["decoupled"]:
+            raise ValueError(
+                "algo.offline.enabled=true drives the coupled train step; decoupled "
+                f"algorithm '{algo_name}' has no offline mode"
+            )
+    elif float(offline_cfg.get("cql_alpha", 0.0) or 0.0) != 0.0:
+        warnings.warn(
+            "algo.offline.cql_alpha is set but algo.offline.enabled=false: the conservative "
+            "penalty WILL apply to the online run's critic update too (it is a train-step "
+            "knob); set it to 0 unless that is intended",
+            UserWarning,
+        )
     learning_starts = cfg.algo.get("learning_starts")
     if learning_starts is not None and learning_starts < 0:
         raise ValueError("The `algo.learning_starts` parameter must be greater or equal to zero")
@@ -303,8 +356,16 @@ def run_algorithm(cfg: dotdict):
     entry = find_algorithm(cfg.algo.name)
     if entry is None:
         raise ValueError(f"Algorithm '{cfg.algo.name}' is not registered")
-    module = importlib.import_module(entry["module"])
-    entrypoint = getattr(module, entry["entrypoint"])
+    if (cfg.algo.get("offline") or {}).get("enabled"):
+        # env-free offline mode: same runtime/diagnostics scaffold, but the
+        # dataset loader replaces the env/player entirely
+        # (sheeprl_tpu/offline/train.py; pipelined_vector_env refuses to run)
+        from sheeprl_tpu.offline.train import offline_main
+
+        entrypoint = offline_main
+    else:
+        module = importlib.import_module(entry["module"])
+        entrypoint = getattr(module, entry["entrypoint"])
 
     # Algo utils module exposes AGGREGATOR_KEYS / MODELS_TO_REGISTER
     # (reference cli.py:151-181): prune metric + model-manager config to what

@@ -92,6 +92,12 @@ class ReplayBuffer:
         self._buf: Dict[str, np.ndarray | MemmapArray] = {}
         self._pos = 0
         self._full = False
+        # monotone count of steps ever added — the logical-stream clock the
+        # incremental dataset export (offline/export.py) cursors against
+        self._added = 0
+        # bytes of exported dataset shards attributed to this buffer
+        # (offline/export.py::note_dataset_bytes); footprint() reports them
+        self.dataset_disk_bytes = 0
         self._rng: np.random.Generator = np.random.default_rng()
 
     # -- properties ---------------------------------------------------------
@@ -119,11 +125,24 @@ class ReplayBuffer:
     def is_memmap(self) -> bool:
         return self._memmap
 
+    @property
+    def added_steps(self) -> int:
+        """Steps ever added (monotone; ``added_steps - buffer_size`` is the
+        oldest logical step still in the ring once full)."""
+        return self._added
+
     def __len__(self) -> int:
         return self._buffer_size
 
     def seed(self, seed: Optional[int]) -> None:
         self._rng = np.random.default_rng(seed)
+
+    def flush(self) -> None:
+        """Force memmap-backed storage to disk — called before any export or
+        snapshot read so the reader never sees pages the OS still holds."""
+        for v in self._buf.values():
+            if isinstance(v, MemmapArray):
+                v.flush()
 
     # -- write path ---------------------------------------------------------
     def _allocate(self, key: str, per_step_shape: tuple, dtype: Any) -> None:
@@ -175,6 +194,7 @@ class ReplayBuffer:
         if head + steps >= self._buffer_size:
             self._full = True
         self._pos = (head + steps) % self._buffer_size
+        self._added += steps
 
     # -- read path ----------------------------------------------------------
     def sample(
@@ -274,8 +294,10 @@ class ReplayBuffer:
     def footprint(self) -> Dict[str, int]:
         """Allocated storage bytes by residence: memmap-backed keys count as
         ``disk_bytes`` (the OS pages them; they do not pin RAM), plain numpy
-        keys as ``host_bytes``.  Journaled per metric interval when the loop
-        registered the buffer with ``diag.track_buffer``."""
+        keys as ``host_bytes``; exported dataset shards (``buffer.export`` /
+        ``sheeprl-export``) as ``dataset_disk``.  Journaled per metric
+        interval when the loop registered the buffer with
+        ``diag.track_buffer``."""
         host = 0
         disk = 0
         for v in self._buf.values():
@@ -283,7 +305,10 @@ class ReplayBuffer:
                 disk += v.nbytes
             else:
                 host += int(v.nbytes)
-        return {"host_bytes": host, "disk_bytes": disk}
+        out = {"host_bytes": host, "disk_bytes": disk}
+        if self.dataset_disk_bytes:
+            out["dataset_disk"] = int(self.dataset_disk_bytes)
+        return out
 
     # -- checkpointing --------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
@@ -291,6 +316,7 @@ class ReplayBuffer:
             "buffer": {k: np.asarray(v).copy() for k, v in self._buf.items()},
             "pos": self._pos,
             "full": self._full,
+            "added": self._added,
         }
 
     def load_state_dict(self, state: Dict[str, Any]) -> "ReplayBuffer":
@@ -303,6 +329,9 @@ class ReplayBuffer:
                 self._buf[k] = v.copy()
         self._pos = state["pos"]
         self._full = state["full"]
+        # checkpoints predating the export subsystem carry no add counter:
+        # the stored span is the best lower bound
+        self._added = int(state.get("added", self._buffer_size if self._full else self._pos))
         return self
 
 
@@ -500,6 +529,7 @@ class EnvIndependentReplayBuffer:
             for b in bufs:
                 b._full = b._full or full
                 b._pos = pos
+                b._added += steps
             return
         for data_idx, env_idx in enumerate(indices):
             env_data = {k: v[:, data_idx : data_idx + 1] for k, v in data.items()}
@@ -542,11 +572,17 @@ class EnvIndependentReplayBuffer:
         )
         return to_device(samples, device=device, dtype=dtype)
 
+    def flush(self) -> None:
+        for b in self._buf:
+            b.flush()
+
     def footprint(self) -> Dict[str, int]:
         out = {"host_bytes": 0, "disk_bytes": 0}
         for b in self._buf:
             for kind, size in b.footprint().items():
                 out[kind] = out.get(kind, 0) + size
+        if getattr(self, "dataset_disk_bytes", 0):
+            out["dataset_disk"] = out.get("dataset_disk", 0) + int(self.dataset_disk_bytes)
         return out
 
     def state_dict(self) -> Dict[str, Any]:
@@ -605,6 +641,12 @@ class EpisodeBuffer:
         self._open_episodes: List[List[Dict[str, np.ndarray]]] = [[] for _ in range(n_envs)]
         self._cum_lengths: List[int] = []
         self._buf: List[Dict[str, np.ndarray | MemmapArray]] = []
+        # monotone per-episode ids (parallel to _buf): the dataset export
+        # keys its one-stream-per-episode layout off these, so an evicted
+        # episode's stream is never reused
+        self._episode_ids: List[int] = []
+        self._episodes_saved = 0
+        self.dataset_disk_bytes = 0
         self._memmap = memmap
         self._memmap_dir = memmap_dir
         self._memmap_mode = memmap_mode
@@ -653,11 +695,23 @@ class EpisodeBuffer:
     def full(self) -> bool:
         return self._cum_lengths[-1] + self._minimum_episode_length > self._buffer_size if self._buf else False
 
+    @property
+    def episode_ids(self) -> Sequence[int]:
+        """Monotone id per stored episode (parallel to :attr:`buffer`)."""
+        return tuple(self._episode_ids)
+
     def __len__(self) -> int:
         return self._cum_lengths[-1] if self._buf else 0
 
     def seed(self, seed: Optional[int]) -> None:
         self._rng = np.random.default_rng(seed)
+
+    def flush(self) -> None:
+        """Force memmap-backed episode storage to disk before export reads."""
+        for episode in self._buf:
+            for v in episode.values():
+                if isinstance(v, MemmapArray):
+                    v.flush()
 
     def add(
         self,
@@ -735,6 +789,7 @@ class EpisodeBuffer:
                     shutil.rmtree(dirname, ignore_errors=True)
             else:
                 self._buf = self._buf[last_to_remove + 1 :]
+            self._episode_ids = self._episode_ids[last_to_remove + 1 :]
             cum_lengths = cum_lengths[last_to_remove + 1 :] - cum_lengths[last_to_remove]
             self._cum_lengths = cum_lengths.tolist()
         self._cum_lengths.append(len(self) + ep_len)
@@ -747,6 +802,8 @@ class EpisodeBuffer:
                 for k, v in episode.items()
             }
         self._buf.append(episode_to_store)
+        self._episode_ids.append(self._episodes_saved)
+        self._episodes_saved += 1
 
     def sample(
         self,
@@ -839,19 +896,26 @@ class EpisodeBuffer:
         for chunks in self._open_episodes:
             for chunk in chunks:
                 host += sum(int(np.asarray(v).nbytes) for v in chunk.values())
-        return {"host_bytes": host, "disk_bytes": disk}
+        out = {"host_bytes": host, "disk_bytes": disk}
+        if self.dataset_disk_bytes:
+            out["dataset_disk"] = int(self.dataset_disk_bytes)
+        return out
 
     def state_dict(self) -> Dict[str, Any]:
         return {
             "buffer": [{k: np.asarray(v).copy() for k, v in ep.items()} for ep in self._buf],
             "cum_lengths": list(self._cum_lengths),
             "open_episodes": self._open_episodes,
+            "episode_ids": list(self._episode_ids),
+            "episodes_saved": self._episodes_saved,
         }
 
     def load_state_dict(self, state: Dict[str, Any]) -> "EpisodeBuffer":
         episodes = state["buffer"]
         self._buf = []
         self._cum_lengths = list(state["cum_lengths"])
+        self._episode_ids = list(state.get("episode_ids", range(len(episodes))))
+        self._episodes_saved = int(state.get("episodes_saved", len(episodes)))
         for ep in episodes:
             if self._memmap:
                 episode_dir = Path(self._memmap_dir) / f"episode_{uuid.uuid4()}"

@@ -112,6 +112,8 @@ class DeviceSequentialReplayBuffer:
         self._buf: Dict[str, jax.Array] = {}
         self._pos = np.zeros(self._n_envs, dtype=np.int64)
         self._filled = np.zeros(self._n_envs, dtype=np.int64)  # rows ever written, capped at size
+        self._added = np.zeros(self._n_envs, dtype=np.int64)  # monotone (dataset-export cursor)
+        self.dataset_disk_bytes = 0
         self._rng = np.random.default_rng()
         # multi-device: the ring is sharded over the mesh's data axis along
         # the env dimension; each device stores and samples only its env block
@@ -144,6 +146,12 @@ class DeviceSequentialReplayBuffer:
     @property
     def is_memmap(self) -> bool:
         return False
+
+    @property
+    def added_steps(self) -> np.ndarray:
+        """Per-env monotone count of steps ever added (envs advance
+        independently here — episode-end rows go only to done envs)."""
+        return self._added.copy()
 
     def __len__(self) -> int:
         return self._buffer_size
@@ -211,6 +219,7 @@ class DeviceSequentialReplayBuffer:
         self._buf = _scatter_all(self._buf, step, rows, envs_dev)
         self._pos[envs] = (self._pos[envs] + 1) % self._buffer_size
         self._filled[envs] = np.minimum(self._filled[envs] + 1, self._buffer_size)
+        self._added[envs] += 1
 
     def mark_last_truncated(self, env_idx: int) -> None:
         """Flag the most recent stored step of one env as truncated (the
@@ -311,7 +320,10 @@ class DeviceSequentialReplayBuffer:
         """HBM-resident storage bytes (``device_bytes`` is the GLOBAL total;
         env-sharded storage splits it evenly across the mesh's devices)."""
         total = sum(int(v.nbytes) for v in self._buf.values())
-        return {"device_bytes": total}
+        out = {"device_bytes": total}
+        if self.dataset_disk_bytes:
+            out["dataset_disk"] = int(self.dataset_disk_bytes)
+        return out
 
     # -- checkpointing ---------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
@@ -321,6 +333,7 @@ class DeviceSequentialReplayBuffer:
             "buffer": {k: np.array(v) for k, v in self._buf.items()},
             "pos": self._pos.copy(),
             "filled": self._filled.copy(),
+            "added": self._added.copy(),
         }
 
     def _to_storage(self, arr) -> jax.Array:
@@ -347,8 +360,15 @@ class DeviceSequentialReplayBuffer:
             self._filled = np.asarray(
                 [self._buffer_size if s["full"] else s["pos"] for s in subs], dtype=np.int64
             )
+            self._added = np.asarray(
+                [s.get("added", self._buffer_size if s["full"] else s["pos"]) for s in subs],
+                dtype=np.int64,
+            )
             return self
         self._buf = {k: self._to_storage(v) for k, v in state["buffer"].items()}
         self._pos = np.asarray(state["pos"], dtype=np.int64).copy()
         self._filled = np.asarray(state["filled"], dtype=np.int64).copy()
+        # checkpoints predating the export subsystem: the stored window is
+        # the best lower bound (mirrors ReplayBuffer.load_state_dict)
+        self._added = np.asarray(state.get("added", self._filled), dtype=np.int64).copy()
         return self

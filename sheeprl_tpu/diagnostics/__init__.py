@@ -495,6 +495,18 @@ class Diagnostics:
         if self.telemetry is not None:
             self.telemetry.note_fetch(n)
 
+    def note_dataset_read(self, n: int) -> None:
+        """Count ``n`` transitions streamed from the offline dataset loader
+        toward ``Telemetry/dataset_read_sps``.  No-op when disabled."""
+        if self.telemetry is not None:
+            self.telemetry.note_dataset_rows(n)
+
+    def note_dataset_epoch(self, epoch: float) -> None:
+        """Record the offline loader's epoch counter
+        (``Telemetry/dataset_epoch``).  No-op when disabled."""
+        if self.telemetry is not None:
+            self.telemetry.note_dataset_epoch(epoch)
+
     def augment_metrics(self, step: Optional[int], metrics: Mapping[str, Any]) -> Mapping[str, Any]:
         """Merge the interval's ``Telemetry/*`` gauges (compute + memory) into
         an aggregated metrics dict (called by the logger proxy before the

@@ -155,6 +155,9 @@ _TELEMETRY_COLUMNS = (
     ("Telemetry/sps", "sps", "{:.0f}"),
     ("Telemetry/env_steps_per_sec", "env-sps", "{:.0f}"),
     ("Telemetry/fetch_amortization", "fetch-amort", "{:.0f}x"),
+    # offline mode (howto/offline_rl.md): the dataset feed replaces env-sps
+    ("Telemetry/dataset_read_sps", "dataset-sps", "{:.0f}"),
+    ("Telemetry/dataset_epoch", "epoch", "{:.0f}"),
     ("Telemetry/tflops_per_sec", "tflops", "{:.2f}"),
     ("Telemetry/mfu", "mfu", "{:.1%}"),
 )

@@ -67,6 +67,9 @@ EVENT_KINDS: Dict[str, str] = {
     "ckpt_skipped": "resume selection rejected a checkpoint (corrupt / truncated / unreadable / incomplete_group) with the reason",
     "params_reject": "decoupled promotion gate fenced a trainer update off the player: reason, step, staleness vs budget (escalate=true on the budget-exhausting rejection, fsync'd)",
     "rollback": "quarantined train-step failure absorbed: trainer params+opt_state restored from the last-good snapshot — error, restored iteration, retries left (fsync'd)",
+    "dataset_export": "replay experience exported as dataset shards (rows/bytes/shards written, cumulative totals, dataset path)",
+    "dataset_open": "offline training opened a dataset: verified streams/segments/shards/rows/bytes and how many shards were skipped",
+    "dataset_shard_skipped": "dataset open rejected a torn/corrupt shard (no_manifest / size_mismatch / digest_mismatch) with the reason",
     "preempted": "graceful preemption: emergency snapshot landed at a loop boundary; the process exits with code 75 (fsync'd)",
     "restart": "supervisor respawned the run after a non-clean exit: attempt, rc, backoff, measured downtime, resume source",
     "run_end": "completed / halted / aborted / preempted — absent after a kill",
@@ -101,6 +104,7 @@ METRICS: Dict[str, str] = {
     "sheeprl_sentinel_events_total": "journaled divergence/sentinel findings",
     "sheeprl_train_flops_total": "cumulative FLOPs dispatched through kind=train steps",
     "sheeprl_env_steps_total": "cumulative environment steps taken by the player",
+    "sheeprl_dataset_rows_read_total": "offline mode: transitions streamed from the dataset loader",
     # memory counters (MemoryMonitor.snapshot()["counters"])
     "sheeprl_host_transfers_total": "transfer-guard trips journaled",
     "sheeprl_donation_miss_leaves_total": "leaves that missed a declared donation",
@@ -124,6 +128,8 @@ METRICS: Dict[str, str] = {
     "sheeprl_sps": "policy steps per second over the last interval",
     "sheeprl_env_steps_per_sec": "environment steps per second over the last interval",
     "sheeprl_fetch_amortization": "env steps amortized by each blocking action fetch",
+    "sheeprl_dataset_read_sps": "offline mode: dataset transitions streamed per second over the last interval",
+    "sheeprl_dataset_epoch": "offline mode: the loader's pass counter over the dataset (deterministic per-epoch shuffle)",
     "sheeprl_recompiles": "recompiles within the last interval",
     "sheeprl_compile_count": "backend compiles within the last interval",
     "sheeprl_compile_time_s": "backend compile seconds within the last interval",
@@ -161,6 +167,7 @@ METRICS: Dict[str, str] = {
     "sheeprl_replay_host_bytes": "replay buffer bytes resident in host RAM",
     "sheeprl_replay_disk_bytes": "replay buffer bytes memmapped on disk",
     "sheeprl_replay_device_bytes": "replay buffer bytes resident in HBM",
+    "sheeprl_replay_dataset_disk": "bytes of exported dataset shards attributed to the tracked replay buffer",
     # serving tier (sheeprl_tpu/serving/server.py snapshot; the serve
     # /metrics endpoint reuses render_prometheus, so the same naming rules
     # apply — tools/run_monitor.py --url keys its serving panel off these)

@@ -655,6 +655,11 @@ class Telemetry:
         self._env_steps_interval = 0
         self._env_steps_total = 0
         self._rollout_calls_interval = 0
+        # offline dataset feed: rows streamed from the loader (the env-free
+        # mode's throughput axis) and the loader's epoch counter
+        self._dataset_rows_interval = 0
+        self._dataset_rows_total = 0
+        self._dataset_epoch: Optional[float] = None
         # watchdog
         self._recompiles_total = 0
         self._recompile_times: deque = deque()
@@ -752,6 +757,19 @@ class Telemetry:
         its action values directly)."""
         with self._lock:
             self._rollout_calls_interval += int(n)
+
+    def note_dataset_rows(self, n: int) -> None:
+        """Count ``n`` transitions streamed from an offline dataset loader —
+        feeds ``Telemetry/dataset_read_sps`` (howto/offline_rl.md)."""
+        with self._lock:
+            self._dataset_rows_interval += int(n)
+            self._dataset_rows_total += int(n)
+
+    def note_dataset_epoch(self, epoch: float) -> None:
+        """Record the offline loader's epoch counter — the
+        ``Telemetry/dataset_epoch`` gauge."""
+        with self._lock:
+            self._dataset_epoch = float(epoch)
 
     def _watchdog_observe(self, inst: _Instrumented, sig, args, kwargs) -> None:
         """One *new* dispatch signature on an already-compiled fn == one
@@ -879,6 +897,8 @@ class Telemetry:
                         out[TELEMETRY_PREFIX + "fetch_amortization"] = (
                             self._env_steps_interval / self._rollout_calls_interval
                         )
+                if self._dataset_rows_interval > 0:
+                    out[TELEMETRY_PREFIX + "dataset_read_sps"] = self._dataset_rows_interval / dt
                 if self._phase_interval:
                     buckets: Dict[str, float] = {}
                     for name, secs in self._phase_interval.items():
@@ -888,6 +908,8 @@ class Telemetry:
                     buckets["idle"] = max(0.0, dt - accounted)
                     for bucket, secs in sorted(buckets.items()):
                         out[TELEMETRY_PREFIX + f"phase_pct/{bucket}"] = 100.0 * secs / dt
+            if self._dataset_epoch is not None:
+                out[TELEMETRY_PREFIX + "dataset_epoch"] = self._dataset_epoch
             out[TELEMETRY_PREFIX + "recompiles"] = float(self._recompiles_total)
             out[TELEMETRY_PREFIX + "compile_count"] = float(self._backend_compiles)
             out[TELEMETRY_PREFIX + "compile_time_s"] = round(self._backend_compile_s, 3)
@@ -897,6 +919,7 @@ class Telemetry:
             self._train_flops_interval = 0.0
             self._env_steps_interval = 0
             self._rollout_calls_interval = 0
+            self._dataset_rows_interval = 0
             self._tick_t = now
             if step is not None:
                 self._tick_step = float(step)
@@ -917,6 +940,7 @@ class Telemetry:
                     "sentinel_events_total": self._sentinel_events,
                     "train_flops_total": self._train_flops_total,
                     "env_steps_total": self._env_steps_total,
+                    "dataset_rows_read_total": self._dataset_rows_total,
                 },
                 "policy_steps": self._tick_step,
                 "phase_seconds_total": dict(self._phase_total),

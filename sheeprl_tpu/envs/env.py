@@ -263,6 +263,16 @@ def pipelined_vector_env(cfg, env_fns):
     :class:`~sheeprl_tpu.envs.pipeline.PipelinedVectorEnv` so the hot loops
     can ``step_async``/``step_wait``.  ``step()`` still works for loops that
     have not been rewired."""
+    if ((cfg.get("algo") or {}).get("offline") or {}).get("enabled"):
+        # the enforced invariant behind the offline-mode acceptance drill:
+        # env-free training must never spawn env workers — an online loop
+        # reached by mistake fails loudly here instead of silently
+        # collecting fresh experience (howto/offline_rl.md)
+        raise RuntimeError(
+            "algo.offline.enabled=true is an env-free training mode: environments must not "
+            "be constructed (the offline entrypoint drives the train step from the dataset "
+            "loader; see sheeprl_tpu/offline/train.py)"
+        )
     from sheeprl_tpu.envs.pipeline import PipelinedVectorEnv
 
     executor = resolve_executor(cfg)

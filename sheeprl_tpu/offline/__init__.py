@@ -1,0 +1,25 @@
+"""Offline RL subsystem (howto/offline_rl.md).
+
+Three layers on top of :mod:`sheeprl_tpu.data.datasets`:
+
+* :mod:`~sheeprl_tpu.offline.export` — turn replay experience into durable
+  sharded datasets: a checkpoint-boundary hook (``buffer.export=True``, the
+  serialization riding the resilience async-writer thread off the critical
+  path), a run-dir converter for finished runs (``sheeprl-export`` /
+  ``tools/export_dataset.py``), and the direct ``export_buffer`` API;
+* :mod:`~sheeprl_tpu.offline.train` — the env-free training mode behind
+  ``algo.offline.enabled=true``: ``cli.run`` skips env/player construction
+  entirely and drives the EXISTING guarded train steps (SAC/DroQ flat
+  batches with an optional conservative-Q penalty, DV3 dynamic learning on
+  sequence windows) from the streaming loader, full diagnostics stack live;
+* ``tools/dataset_report.py`` — shard table / episode histogram / reward
+  summary over a dataset's manifests and the source run journal.
+"""
+
+from sheeprl_tpu.offline.export import (
+    BufferDatasetExporter,
+    export_buffer,
+    export_run_dir,
+)
+
+__all__ = ["BufferDatasetExporter", "export_buffer", "export_run_dir"]

@@ -1,0 +1,546 @@
+"""Env-free offline training (``algo.offline.enabled=true``).
+
+``cli.run_algorithm`` routes here instead of the registered online
+entrypoint: no env or player is ever constructed (``pipelined_vector_env``
+actively refuses to run in this mode), and the EXISTING guarded train steps
+are driven from the :class:`~sheeprl_tpu.data.datasets.OfflineDataset`
+streaming loader instead of a live replay buffer:
+
+* **SAC / DroQ** — flat transition batches (D4RL-style fixed-dataset
+  off-policy training; ``algo.offline.cql_alpha > 0`` adds the conservative
+  Q penalty the train-step builders grew for exactly this mode);
+* **DreamerV3** — contiguous ``[T, B]`` sequence windows drive the full
+  dynamic-learning step (world model + imagination actor/critic) — offline
+  world-model pretraining from any exported Dreamer dataset, ``rssm_*``
+  stored-state keys included.
+
+The full diagnostics stack stays live: the run journals ``dataset_open`` (+
+one ``dataset_shard_skipped`` per torn/corrupt shard), gauges
+``Telemetry/dataset_read_sps`` / ``Telemetry/dataset_epoch`` ride the metric
+intervals and ``/metrics``, checkpoints flow through the resilience layer
+(async writer + manifest sidecars) and the sentinel/health hooks see every
+update.  The step counter of an offline run counts *gradient steps*
+(``algo.total_steps`` = total optimizer steps; there are no env frames).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from math import prod
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+#: Algorithms the offline mode can drive (validated in ``cli.check_configs``).
+OFFLINE_ALGOS: Tuple[str, ...] = ("sac", "droq", "dreamer_v3")
+
+
+def offline_main(runtime, cfg):
+    """Entry point ``cli.run_algorithm`` launches when ``algo.offline.enabled``."""
+    name = cfg.algo.name
+    if name in ("sac", "droq"):
+        return _offline_flat(runtime, cfg)
+    if name == "dreamer_v3":
+        return _offline_dreamer(runtime, cfg)
+    raise ValueError(
+        f"algo.offline.enabled=true supports {sorted(OFFLINE_ALGOS)}, got algo.name={name!r}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared scaffold
+
+
+def _open_run(runtime, cfg):
+    """Logger + log dir + diagnostics + verified dataset — the env-free
+    replacement for every online loop's env/player preamble."""
+    from sheeprl_tpu.config import instantiate
+    from sheeprl_tpu.data.datasets import OfflineDataset
+    from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+    from sheeprl_tpu.utils.utils import get_diagnostics, save_configs
+
+    offline = cfg.algo.get("offline") or {}
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    diag = get_diagnostics(runtime, cfg, log_dir)
+    dataset = OfflineDataset(
+        str(offline.get("dataset_dir")),
+        deep_verify=bool(offline.get("deep_verify", True)),
+    )
+    # the dataset-side ckpt_skipped analogue: one journaled record per
+    # torn/corrupt shard, then the open summary — training continues on the
+    # verified remainder
+    for skip in dataset.skipped:
+        diag._journal_event("dataset_shard_skipped", **skip)
+    diag._journal_event("dataset_open", **dataset.summary())
+    aggregator = instantiate(cfg.metric.aggregator)
+    if cfg.metric.log_level == 0:
+        aggregator.disabled = True
+    if cfg.algo.get("run_test"):
+        warnings.warn(
+            "algo.run_test is ignored in offline mode (there is no environment to test in); "
+            "evaluate the final checkpoint with sheeprl-eval instead",
+            UserWarning,
+        )
+    return logger, log_dir, diag, dataset, aggregator, offline
+
+
+def _offline_action_space(act_dim: int, offline: Dict[str, Any]):
+    """Action space for the dataset's actions: bounds from the
+    ``algo.offline.action_low/high`` knobs, canonical ±1 otherwise (tanh
+    policies need finite bounds; the collect env's exact bounds are not part
+    of the dataset record)."""
+    import gymnasium as gym
+
+    low = offline.get("action_low")
+    high = offline.get("action_high")
+    low = -1.0 if low is None else low
+    high = 1.0 if high is None else high
+    low_arr = np.broadcast_to(np.asarray(low, np.float32), (act_dim,)).copy()
+    high_arr = np.broadcast_to(np.asarray(high, np.float32), (act_dim,)).copy()
+    if not (np.isfinite(low_arr).all() and np.isfinite(high_arr).all()):
+        raise ValueError(
+            "algo.offline.action_low/high must be finite (tanh policies rescale by them), "
+            f"got {low!r} / {high!r}"
+        )
+    return gym.spaces.Box(low_arr, high_arr, (act_dim,), np.float32)
+
+
+def _grad_plan(cfg, offline: Dict[str, Any]) -> Tuple[int, int]:
+    """(iterations, gradient steps per iteration): ``algo.total_steps`` is
+    the total optimizer-step budget in offline mode."""
+    per_iter = int(offline.get("grad_steps_per_iter", 16) or 16)
+    if cfg.dry_run:
+        return 1, 1
+    total = max(1, int(cfg.algo.total_steps))
+    per_iter = max(1, min(per_iter, total))
+    return max(1, total // per_iter), per_iter
+
+
+def _resume_counters(state) -> Tuple[int, int, int, int]:
+    """(start_iter, policy_step, last_log, last_checkpoint) for a resumed
+    run.  Only checkpoints written BY the offline mode continue the offline
+    schedule: an online collect run's ``iter_num``/``policy_step`` count env
+    iterations, and reinterpreting them as gradient-step counters would make
+    fine-tuning a no-op (the loop would start past ``total_iters``).  Online
+    checkpoints therefore restore agent/optimizer state but start a fresh
+    offline budget at step 0."""
+    if state and state.get("offline"):
+        return state["iter_num"] + 1, state["policy_step"], state["last_log"], state["last_checkpoint"]
+    return 1, 0, 0, 0
+
+
+def _save_offline_checkpoint(runtime, diag, cfg, log_dir, state, policy_step, iter_num, preempt):
+    ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt")
+    with diag.span("checkpoint"):
+        runtime.call(
+            "on_checkpoint_coupled", ckpt_path=ckpt_path, state=state, replay_buffer=None
+        )
+    diag.on_checkpoint(policy_step, ckpt_path)
+    if preempt:
+        diag.on_preempted(policy_step, iter_num, ckpt_path)
+    return ckpt_path
+
+
+# ---------------------------------------------------------------------------
+# SAC / DroQ: flat transition batches
+
+
+def _offline_flat(runtime, cfg):
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.envs.player import fetch_values
+    from sheeprl_tpu.parallel.dp import local_sample_size
+    from sheeprl_tpu.parallel.mesh import replicated_sharding
+    from sheeprl_tpu.parallel.precision import cast_floating
+    from sheeprl_tpu.config import instantiate
+    from sheeprl_tpu.utils.timer import timer
+
+    name = cfg.algo.name
+    world_size = runtime.world_size
+    rng_key = runtime.seed_everything(cfg.seed)
+    logger, log_dir, diag, dataset, aggregator, offline = _open_run(runtime, cfg)
+    timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+
+    for key in ("observations", "actions", "rewards", "terminated"):
+        if key not in dataset.key_specs:
+            raise ValueError(
+                f"offline {name} needs the '{key}' key; the dataset at "
+                f"'{dataset.root}' carries {sorted(dataset.keys)}"
+            )
+    obs_dim = int(prod(dataset.key_specs["observations"][0]))
+    act_dim = int(prod(dataset.key_specs["actions"][0]))
+    mlp_keys = list(cfg.algo.mlp_keys.encoder) or ["state"]
+    if len(mlp_keys) > 1:
+        # the dataset stores the FLAT concat the collect loop built — one
+        # synthetic key carries it whole (bit-identical network input)
+        warnings.warn(
+            f"offline {name}: dataset observations are pre-flattened; collapsing "
+            f"algo.mlp_keys.encoder={mlp_keys} onto '{mlp_keys[0]}'",
+            UserWarning,
+        )
+        cfg.algo.mlp_keys.encoder = mlp_keys[:1]
+    obs_space = gym.spaces.Dict(
+        {mlp_keys[0]: gym.spaces.Box(-np.inf, np.inf, (obs_dim,), np.float32)}
+    )
+    action_space = _offline_action_space(act_dim, offline)
+
+    state = runtime.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    if name == "droq":
+        from sheeprl_tpu.algos.droq.agent import build_agent
+        from sheeprl_tpu.algos.droq.droq import make_train_step
+    else:
+        from sheeprl_tpu.algos.sac.agent import build_agent
+        from sheeprl_tpu.algos.sac.sac import make_train_step
+    actor_def, critic_def, params, target_entropy = build_agent(
+        runtime, cfg, obs_space, action_space, state["agent"] if state else None
+    )
+    params = cast_floating(params, runtime.param_dtype)
+    optimizers = {
+        "actor": instantiate(cfg.algo.actor.optimizer),
+        "critic": instantiate(cfg.algo.critic.optimizer),
+        "alpha": instantiate(cfg.algo.alpha.optimizer),
+    }
+    opt_states = {
+        "actor": optimizers["actor"].init(params["actor"]),
+        "critic": optimizers["critic"].init(params["critic"]),
+        "alpha": optimizers["alpha"].init(params["log_alpha"]),
+    }
+    if state and "opt_states" in state:
+        opt_states = jax.tree_util.tree_map(
+            lambda ref, saved: jnp.asarray(saved, dtype=getattr(ref, "dtype", None)),
+            opt_states,
+            state["opt_states"],
+        )
+    if world_size > 1:
+        params = jax.device_put(params, replicated_sharding(runtime.mesh))
+        opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
+
+    if name == "droq":
+        train_step = diag.instrument(
+            "train_step",
+            make_train_step(
+                actor_def, critic_def, optimizers, cfg, target_entropy,
+                mesh=runtime.mesh if world_size > 1 else None,
+            ),
+            kind="train",
+            donate_argnums=(0, 1),
+        )
+    else:
+        train_step = diag.instrument(
+            "train_step",
+            make_train_step(actor_def, critic_def, optimizers, cfg, runtime.mesh, target_entropy),
+            kind="train",
+            donate_argnums=(0, 1),
+        )
+    diag.register_footprint("params", params)
+    diag.register_footprint("opt_state", opt_states)
+
+    total_iters, grad_per_iter = _grad_plan(cfg, offline)
+    batch_rows = local_sample_size(cfg.algo.per_rank_batch_size * world_size)
+    train_keys = [
+        k
+        for k in ("observations", "next_observations", "actions", "rewards", "terminated")
+        if k in dataset.key_specs
+    ]
+    derive_next = "next_observations" not in dataset.key_specs
+    epoch_box = {"epoch": 0}
+
+    def feed(seed_salt: int, keys: List[str], derive: bool):
+        return dataset.batches(
+            batch_rows * grad_per_iter,
+            seed=int(cfg.seed) + seed_salt,
+            mode="flat",
+            keys=keys,
+            derive_next_obs=derive,
+            next_obs_keys=("observations",),
+            shuffle_window=int(offline.get("shuffle_window") or (1 << 16)),
+            prefetch=int(offline.get("prefetch", 2) or 0),
+            on_epoch=lambda e: epoch_box.__setitem__("epoch", e),
+        )
+
+    batches = feed(0, train_keys, derive_next)
+    actor_batches = feed(1, ["observations"], False) if name == "droq" else None
+
+    start_iter, policy_step_count, last_log, last_checkpoint = _resume_counters(state)
+
+    for iter_num in range(start_iter, total_iters + 1):
+        with timer("Time/train_time"):
+            with diag.span("buffer-sample"):
+                host = next(batches)
+                rows = batch_rows * grad_per_iter
+                data = {
+                    k: jnp.asarray(np.asarray(v), jnp.float32).reshape(
+                        grad_per_iter, batch_rows, *np.asarray(v).shape[1:]
+                    )
+                    for k, v in host.items()
+                }
+                if name == "droq":
+                    actor_host = next(actor_batches)
+                    rows += batch_rows * grad_per_iter  # the second stream counts too
+                    actor_data = {
+                        k: jnp.asarray(np.asarray(v), jnp.float32).reshape(
+                            grad_per_iter, batch_rows, *np.asarray(v).shape[1:]
+                        )
+                        for k, v in actor_host.items()
+                    }
+            data = diag.maybe_inject_nan(iter_num, data)
+            with diag.span("train"):
+                rng_key, scan_key = jax.random.split(rng_key)
+                keys = jax.random.split(scan_key, grad_per_iter)
+                if name == "droq":
+                    params, opt_states, losses = train_step(params, opt_states, data, actor_data, keys)
+                    losses, health_host = np.asarray(losses), {}
+                    nonfinite = float(np.sum(~np.isfinite(losses)))
+                else:
+                    params, opt_states, losses, health = train_step(params, opt_states, data, keys)
+                    losses, health_host = fetch_values(losses, health)
+                    nonfinite = float(losses[4])
+        policy_step_count += grad_per_iter
+        diag.note_dataset_read(rows)
+        diag.note_dataset_epoch(epoch_box["epoch"])
+        diag.on_health(policy_step_count, health_host)
+        stats = {
+            "Loss/value_loss": float(losses[0]),
+            "Loss/policy_loss": float(losses[1]),
+            "Loss/alpha_loss": float(losses[2]),
+        }
+        if name != "droq":
+            stats["Grads/global_norm"] = float(losses[3])
+        for key, value in stats.items():
+            aggregator.update(key, value)
+        diag.on_update(policy_step_count, stats, nonfinite=nonfinite)
+
+        if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
+            metrics = aggregator.compute()
+            timers = timer.compute()
+            if timers.get("Time/train_time", 0) > 0:
+                metrics["Time/sps_train"] = (policy_step_count - last_log) / timers["Time/train_time"]
+            if runtime.is_global_zero:
+                logger.log_metrics(metrics, policy_step_count)
+            aggregator.reset()
+            timer.reset()
+            last_log = policy_step_count
+
+        preempt_now = diag.preempt_due(iter_num)
+        if (
+            (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or preempt_now
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step_count
+            ckpt_state = {
+                "agent": jax.tree_util.tree_map(np.asarray, params),
+                "opt_states": jax.tree_util.tree_map(np.asarray, opt_states),
+                "offline": True,  # counters below are gradient-step counters
+                "iter_num": iter_num,
+                "policy_step": policy_step_count,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+            }
+            _save_offline_checkpoint(
+                runtime, diag, cfg, log_dir, ckpt_state, policy_step_count, iter_num, preempt_now
+            )
+
+    logger.finalize()
+    diag.close("completed")
+
+
+# ---------------------------------------------------------------------------
+# DreamerV3: sequence windows drive the dynamic-learning step
+
+
+def _offline_dreamer(runtime, cfg):
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+        METRIC_ORDER,
+        _build_agent_from_state,
+        _default_make_optimizers,
+        make_train_step,
+    )
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments_state, rssm_scan_spec
+    from sheeprl_tpu.config import instantiate
+    from sheeprl_tpu.parallel.dp import local_sample_size, normalize_staged, stage
+    from sheeprl_tpu.parallel.mesh import replicated_sharding
+    from sheeprl_tpu.parallel.precision import cast_floating
+    from sheeprl_tpu.utils.timer import timer
+
+    world_size = runtime.world_size
+    rng_key = runtime.seed_everything(cfg.seed)
+    logger, log_dir, diag, dataset, aggregator, offline = _open_run(runtime, cfg)
+    timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    needed = obs_keys + ["actions", "rewards", "terminated", "is_first"]
+    if rssm_scan_spec(cfg)[0] > 1:
+        needed += ["rssm_recurrent", "rssm_posterior", "rssm_valid"]
+    missing = [k for k in needed if k not in dataset.key_specs]
+    if missing:
+        raise ValueError(
+            f"offline dreamer_v3 needs dataset keys {missing} which '{dataset.root}' does not "
+            f"carry (have {sorted(dataset.keys)}); for rssm_* keys re-collect with "
+            "algo.rssm_chunks > 1 or train with algo.rssm_chunks=1"
+        )
+
+    spaces: Dict[str, gym.spaces.Space] = {}
+    for k in obs_keys:
+        shape, dtype = dataset.key_specs[k]
+        if np.dtype(dtype) == np.uint8:
+            spaces[k] = gym.spaces.Box(0, 255, shape, np.uint8)
+        else:
+            # the collect loop stores mlp keys with a trailing feature axis
+            spaces[k] = gym.spaces.Box(-np.inf, np.inf, shape, np.float32)
+    obs_space = gym.spaces.Dict(spaces)
+    stored_act_dim = int(prod(dataset.key_specs["actions"][0]))
+    actions_dim = offline.get("actions_dim")
+    actions_dim = tuple(int(d) for d in actions_dim) if actions_dim else (stored_act_dim,)
+    if int(sum(actions_dim)) != stored_act_dim:
+        raise ValueError(
+            f"algo.offline.actions_dim={list(actions_dim)} sums to {sum(actions_dim)} but the "
+            f"dataset stores {stored_act_dim}-dim actions"
+        )
+    is_continuous = offline.get("is_continuous")
+    if is_continuous is None:
+        # no explicit family: an un-annotated dataset is treated as one flat
+        # continuous action vector (the exporter stores the raw action concat)
+        is_continuous = not offline.get("actions_dim")
+    is_continuous = bool(is_continuous)
+
+    state = runtime.load(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+    world_model_def, actor_def, critic_def, params = _build_agent_from_state(
+        runtime, actions_dim, is_continuous, cfg, obs_space, state
+    )
+    params = cast_floating(params, runtime.param_dtype)
+    optimizers, opt_states = _default_make_optimizers(cfg, params, state)
+    moments_state = init_moments_state()
+    if state and "moments" in state:
+        moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
+    if world_size > 1:
+        params = jax.device_put(params, replicated_sharding(runtime.mesh))
+        opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
+        moments_state = jax.device_put(moments_state, replicated_sharding(runtime.mesh))
+
+    train_step = diag.instrument(
+        "train_step",
+        make_train_step(
+            world_model_def,
+            actor_def,
+            critic_def,
+            optimizers,
+            cfg,
+            actions_dim,
+            is_continuous,
+            mesh=runtime.mesh if world_size > 1 else None,
+        ),
+        kind="train",
+        donate_argnums=(0, 1, 2),
+    )
+    diag.register_footprint("params", params)
+    diag.register_footprint("opt_state", opt_states)
+    diag.register_footprint("moments", moments_state)
+
+    total_iters, grad_per_iter = _grad_plan(cfg, offline)
+    seq_len = int(offline.get("sequence_length") or cfg.algo.per_rank_sequence_length)
+    batch_cols = local_sample_size(cfg.algo.per_rank_batch_size * world_size)
+    epoch_box = {"epoch": 0}
+    batches = dataset.batches(
+        batch_cols,
+        seed=int(cfg.seed),
+        mode="sequence",
+        sequence_length=seq_len,
+        keys=needed,
+        respect_episodes=bool(offline.get("respect_episodes", False)),
+        shuffle_window=int(offline.get("shuffle_window") or (1 << 16)),
+        prefetch=int(offline.get("prefetch", 2) or 0),
+        on_epoch=lambda e: epoch_box.__setitem__("epoch", e),
+    )
+    mesh = runtime.mesh if world_size > 1 else None
+
+    start_iter, policy_step_count, last_log, last_checkpoint = _resume_counters(state)
+    cumulative_grad_steps = 0
+
+    for iter_num in range(start_iter, total_iters + 1):
+        with timer("Time/train_time"):
+            metric_rows: List[np.ndarray] = []
+            for _ in range(grad_per_iter):
+                with diag.span("buffer-sample"):
+                    host = next(batches)
+                    batch = normalize_staged(stage(host, mesh, batch_axis=1), cnn_keys)
+                batch = diag.maybe_inject_nan(iter_num, batch)
+                with diag.span("train"):
+                    target_freq = cfg.algo.critic.get("per_rank_target_network_update_freq", 0)
+                    if target_freq and cumulative_grad_steps % target_freq == 0:
+                        tau = 1.0 if cumulative_grad_steps == 0 else cfg.algo.critic.get("tau", 1.0)
+                    else:
+                        tau = 0.0
+                    rng_key, train_key = jax.random.split(rng_key)
+                    out = train_step(
+                        params, opt_states, moments_state, batch, train_key, jnp.float32(tau)
+                    )
+                    params, opt_states, moments_state, metrics = out[:4]
+                    step_health = out[4] if len(out) > 4 else {}
+                    cumulative_grad_steps += 1
+                metric_rows.append(np.asarray(metrics))
+                if step_health:
+                    from sheeprl_tpu.envs.player import fetch_values
+
+                    (health_host,) = fetch_values(step_health)
+                    diag.on_health(policy_step_count, health_host)
+        policy_step_count += grad_per_iter
+        diag.note_dataset_read(grad_per_iter * batch_cols * seq_len)
+        diag.note_dataset_epoch(epoch_box["epoch"])
+        diag.observe_rows(policy_step_count, METRIC_ORDER, metric_rows)
+        for row in metric_rows:
+            for key, value in zip(METRIC_ORDER, row):
+                if np.isfinite(value):
+                    aggregator.update(key, float(value))
+
+        if policy_step_count - last_log >= cfg.metric.log_every or iter_num == total_iters or cfg.dry_run:
+            metrics_dict = aggregator.compute()
+            timers = timer.compute()
+            if timers.get("Time/train_time", 0) > 0:
+                metrics_dict["Time/sps_train"] = (policy_step_count - last_log) / timers["Time/train_time"]
+            if runtime.is_global_zero:
+                logger.log_metrics(metrics_dict, policy_step_count)
+            aggregator.reset()
+            timer.reset()
+            last_log = policy_step_count
+
+        preempt_now = diag.preempt_due(iter_num)
+        if (
+            (cfg.checkpoint.every > 0 and policy_step_count - last_checkpoint >= cfg.checkpoint.every)
+            or cfg.dry_run
+            or preempt_now
+            or (iter_num == total_iters and cfg.checkpoint.save_last)
+        ):
+            last_checkpoint = policy_step_count
+            ckpt_state = {
+                **{k: jax.tree_util.tree_map(np.asarray, v) for k, v in params.items()},
+                "opt_states": jax.tree_util.tree_map(np.asarray, opt_states),
+                "moments": jax.tree_util.tree_map(np.asarray, moments_state),
+                "offline": True,  # counters below are gradient-step counters
+                "iter_num": iter_num,
+                "policy_step": policy_step_count,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            _save_offline_checkpoint(
+                runtime, diag, cfg, log_dir, ckpt_state, policy_step_count, iter_num, preempt_now
+            )
+
+    logger.finalize()
+    diag.close("completed")

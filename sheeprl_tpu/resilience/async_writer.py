@@ -27,6 +27,10 @@ from typing import Any, Callable, Dict, Mapping, Optional
 
 import numpy as np
 
+#: Queue-entry sentinel marking a submitted callable (``submit_task``) rather
+#: than a checkpoint snapshot.
+_TASK = object()
+
 
 def host_snapshot(tree: Any):
     """Cheap, self-owned host copy of a checkpoint state tree: numpy leaves
@@ -106,6 +110,25 @@ class AsyncCheckpointWriter:
             self._cond.notify_all()
         return self._clock() - t0
 
+    def submit_task(self, fn: Callable[[], None]) -> None:
+        """Enqueue an arbitrary off-critical-path task on the writer thread
+        (dataset shard serialization rides here behind ``buffer.export`` —
+        same FIFO as checkpoint writes, same backpressure, drained by
+        ``drain``/``close`` so a preemption never abandons queued shards).
+        A failing task warns and is dropped; it never raises into the loop."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            while len(self._queue) >= self.max_pending and not self._closed:
+                self._cond.wait(timeout=1.0)
+            self._queue.append((_TASK, fn, None, time.time(), None, None))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, name="sheeprl-ckpt-writer", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+
     # -- consumer side (the writer thread) -----------------------------------
     def _worker(self) -> None:
         while True:
@@ -118,7 +141,16 @@ class AsyncCheckpointWriter:
                 self._writing = True
                 self._cond.notify_all()
             try:
-                self._write_one(path, snapshot, step, enqueued_t, group, delay_s)
+                if path is _TASK:
+                    try:
+                        snapshot()  # the submitted callable
+                    except Exception as err:
+                        warnings.warn(
+                            f"async writer task failed: {err!r} (the run continues)",
+                            RuntimeWarning,
+                        )
+                else:
+                    self._write_one(path, snapshot, step, enqueued_t, group, delay_s)
             finally:
                 with self._cond:
                     self._writing = False

@@ -95,8 +95,12 @@ class CheckpointCallback:
     does not leak across the checkpoint boundary.
     """
 
-    def __init__(self, keep_last: Optional[int] = None):
+    def __init__(self, keep_last: Optional[int] = None, export: bool = False):
         self.keep_last = keep_last
+        # buffer.export (howto/offline_rl.md): snapshot the replay window as
+        # durable dataset shards at every checkpoint boundary — row copies on
+        # the caller, serialization on the resilience async-writer thread
+        self.export = bool(export)
 
     def on_checkpoint_coupled(
         self,
@@ -111,6 +115,10 @@ class CheckpointCallback:
         runtime.save(ckpt_path, state)
         if replay_buffer is not None:
             self._experiment_consistent_rb(replay_buffer)
+            if self.export:
+                from sheeprl_tpu.offline.export import checkpoint_export
+
+                checkpoint_export(self, runtime, ckpt_path, replay_buffer)
         if self.keep_last:
             self._delete_old_checkpoints(Path(ckpt_path).parent)
 

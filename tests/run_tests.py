@@ -29,8 +29,15 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
   golden vs unbatched apply, the hot-reload promotion race and
   health-gate verdicts, and the train-then-serve CLI e2e — batched
   `/act` bit-parity, two-clients-one-dispatch amortization, journaled
-  `ckpt_promote`/`ckpt_reject`), plus `tests/test_tools/test_lint.py` (the
-  static-analysis framework itself).  The suite is preceded by the full
+  `ckpt_promote`/`ckpt_reject`), the offline-RL suite
+  (`tests/test_offline/`: export→load bit-exactness vs the live buffers
+  across every buffer class, torn/corrupt-shard skipping with journaled
+  `dataset_shard_skipped`, deterministic seeded shuffles with
+  prefetch-on ≡ prefetch-off parity, the run-dir converter and
+  checkpoint-boundary `buffer.export` hook, `algo.offline` config
+  validation + the env-construction guard, and the slow-marked SAC
+  collect→export→offline-train acceptance drill), plus
+  `tests/test_tools/test_lint.py` (the static-analysis framework itself).  The suite is preceded by the full
   `tools/sheeprl_lint.py` run (all pass families: INS instrumentation/
   donation wiring, JIT traced-body purity, CFG config contracts, JRN
   journal/metric schemas, ASY async-env discipline — see howto/lint.md),

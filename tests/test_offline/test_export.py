@@ -1,0 +1,168 @@
+"""Run-dir converter + checkpoint-boundary live export + the async-writer
+task lane (howto/offline_rl.md)."""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import yaml
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.datasets import OfflineDataset, read_dataset_meta
+from sheeprl_tpu.diagnostics.journal import RunJournal
+from sheeprl_tpu.offline.export import export_run_dir, main as export_cli
+from sheeprl_tpu.resilience.async_writer import AsyncCheckpointWriter
+from sheeprl_tpu.resilience.manifest import save_verified_checkpoint
+from sheeprl_tpu.utils.checkpoint import CheckpointCallback
+
+
+def _fill(rb, steps, rng, n_envs=2):
+    for _ in range(steps):
+        rb.add(
+            {
+                "observations": rng.standard_normal((1, n_envs, 4)).astype(np.float32),
+                "actions": rng.standard_normal((1, n_envs, 2)).astype(np.float32),
+                "rewards": rng.standard_normal((1, n_envs, 1)).astype(np.float32),
+                "terminated": np.zeros((1, n_envs, 1), np.float32),
+                "truncated": np.zeros((1, n_envs, 1), np.float32),
+            }
+        )
+
+
+def _fake_run_dir(tmp_path, rng, steps=10):
+    """A minimal but real run dir: archived config, journal with run_start +
+    reward metrics, and a manifest-verified checkpoint carrying the replay
+    state — exactly what a ``buffer.checkpoint=True`` run leaves behind."""
+    run_dir = tmp_path / "run"
+    version = run_dir / "version_0"
+    (version / "checkpoint").mkdir(parents=True)
+    with open(version / "config.yaml", "w") as fp:
+        yaml.safe_dump(
+            {
+                "algo": {"name": "sac", "mlp_keys": {"encoder": ["state"]}},
+                "env": {"id": "continuous_dummy", "num_envs": 2},
+                "seed": 7,
+            },
+            fp,
+        )
+    journal = RunJournal(str(version / "journal.jsonl"))
+    journal.write("run_start", run_id="run/version_0", algo="sac", env="continuous_dummy", seed=7)
+    journal.write("metrics", step=8, metrics={"Rewards/rew_avg": 1.5})
+    journal.write("metrics", step=16, metrics={"Rewards/rew_avg": 2.5})
+    journal.close()
+    rb = ReplayBuffer(32, 2, obs_keys=("observations",))
+    _fill(rb, steps, rng)
+    save_verified_checkpoint(
+        str(version / "checkpoint" / f"ckpt_{steps * 2}_0.ckpt"),
+        {"agent": {"w": np.ones(3, np.float32)}, "rb": rb.state_dict(), "policy_step": steps * 2},
+    )
+    return run_dir, rb
+
+
+def test_export_run_dir_converts_newest_verified_checkpoint(tmp_path):
+    rng = np.random.default_rng(0)
+    run_dir, rb = _fake_run_dir(tmp_path, rng)
+    out = export_run_dir(str(run_dir))
+    assert out["rows"] == 20 and out["path"] == str(run_dir / "dataset")
+    ds = OfflineDataset(out["path"])
+    for env in (0, 1):
+        got = ds.gather_window(env, 0, 10)
+        for key in rb.buffer:
+            assert np.array_equal(got[key], np.asarray(rb.buffer[key])[:10, env])
+    meta = read_dataset_meta(out["path"])["meta"]
+    assert meta["algo"] == "sac" and meta["env_id"] == "continuous_dummy" and meta["seed"] == 7
+    assert meta["journal"]["reward_mean"] == 2.0 and meta["journal"]["episodes_logged"] == 2
+    assert meta["checkpoint"]["step"] == 20
+
+
+def test_export_run_dir_requires_replay_state(tmp_path):
+    (tmp_path / "empty" / "checkpoint").mkdir(parents=True)
+    save_verified_checkpoint(
+        str(tmp_path / "empty" / "checkpoint" / "ckpt_4_0.ckpt"), {"agent": {}}
+    )
+    with pytest.raises(ValueError, match="no replay state"):
+        export_run_dir(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError, match="No verifiable checkpoint"):
+        export_run_dir(str(tmp_path / "nowhere"))
+
+
+def test_export_cli_main(tmp_path, capsys):
+    rng = np.random.default_rng(1)
+    run_dir, _ = _fake_run_dir(tmp_path, rng)
+    assert export_cli([str(run_dir), "--out", str(tmp_path / "out"), "--shard-rows", "4"]) == 0
+    assert "exported 20 steps" in capsys.readouterr().out
+    assert OfflineDataset(str(tmp_path / "out")).total_rows == 20
+    assert export_cli([str(tmp_path / "missing")]) == 2
+
+
+class _FakeRuntime:
+    diagnostics = None
+
+    def save(self, path, state):
+        save_verified_checkpoint(path, state)
+
+    def call(self, hook, **kwargs):  # pragma: no cover - unused here
+        raise AssertionError
+
+
+def test_checkpoint_callback_export_knob(tmp_path):
+    """``buffer.export=True``: every checkpoint boundary appends exactly the
+    new rows to ``<run dir>/dataset`` (synchronous fallback path — no
+    resilience writer on the fake runtime)."""
+    rng = np.random.default_rng(2)
+    rb = ReplayBuffer(32, 2, obs_keys=("observations",))
+    _fill(rb, 6, rng)
+    callback = CheckpointCallback(export=True)
+    run_dir = tmp_path / "logs" / "version_0"
+    runtime = _FakeRuntime()
+    callback.on_checkpoint_coupled(
+        runtime, str(run_dir / "checkpoint" / "ckpt_12_0.ckpt"), {"policy_step": 12}, replay_buffer=rb
+    )
+    ds = OfflineDataset(str(run_dir / "dataset"))
+    assert ds.total_rows == 12
+    _fill(rb, 3, rng)
+    callback.on_checkpoint_coupled(
+        runtime, str(run_dir / "checkpoint" / "ckpt_18_0.ckpt"), {"policy_step": 18}, replay_buffer=rb
+    )
+    assert OfflineDataset(str(run_dir / "dataset")).total_rows == 18
+    # the exported rows carry the TRUE stream — the checkpoint's
+    # truncated-flag surgery was undone before the export copied
+    got = OfflineDataset(str(run_dir / "dataset")).gather_window(0, 0, 9)
+    assert not got["truncated"].any()
+    # export=False never creates a dataset
+    rb2 = ReplayBuffer(8, 1, obs_keys=("observations",))
+    _fill(rb2, 2, rng, n_envs=1)
+    CheckpointCallback(export=False).on_checkpoint_coupled(
+        runtime, str(tmp_path / "plain" / "checkpoint" / "ckpt_2_0.ckpt"), {}, replay_buffer=rb2
+    )
+    assert not (tmp_path / "plain" / "dataset").exists()
+
+
+def test_async_writer_task_lane(tmp_path):
+    """``submit_task`` runs callables on the writer thread, FIFO with
+    checkpoint writes, drained by close()."""
+    writer = AsyncCheckpointWriter()
+    order = []
+    done = threading.Event()
+    writer.submit(str(tmp_path / "ckpt_1_0.ckpt"), {"w": np.ones(4)}, step=1)
+    writer.submit_task(lambda: order.append("task1"))
+    writer.submit_task(lambda: (order.append("task2"), done.set()))
+    assert done.wait(timeout=30)
+    writer.close()
+    assert order == ["task1", "task2"]
+    assert os.path.isfile(tmp_path / "ckpt_1_0.ckpt")
+    # a failing task warns but never raises / wedges the writer
+    writer2 = AsyncCheckpointWriter()
+    with pytest.warns(RuntimeWarning, match="task failed"):
+        writer2.submit_task(lambda: 1 / 0)
+        deadline = time.monotonic() + 30
+        while writer2.busy and time.monotonic() < deadline:
+            time.sleep(0.01)
+        writer2.close()
+    with pytest.raises(RuntimeError):
+        writer2.submit_task(lambda: None)

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Convert an existing run dir into a durable offline-RL dataset.
+
+Thin launcher over ``sheeprl_tpu.offline.export`` (same CLI as
+``sheeprl-export`` / ``python -m sheeprl_tpu export``), runnable straight
+from a checkout:
+
+    python tools/export_dataset.py logs/runs/sac/LunarLanderContinuous-v3/<run>/
+    python tools/export_dataset.py <run dir> --out /data/sets/sac_lander --shard-rows 8192
+
+The converter loads the replay state of the run's newest manifest-verified
+checkpoint (``buffer.checkpoint=True`` runs), writes sharded ``.npz`` files
+with digest manifests, and records the run journal's identity/reward
+metadata in ``dataset.json``.  See ``howto/offline_rl.md`` for the format
+and ``tools/dataset_report.py`` for the inspection view.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# runnable straight from a checkout: tools/ is not a package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.offline.export import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

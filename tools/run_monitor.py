@@ -241,6 +241,9 @@ def endpoint_status(url: str) -> str:
         ("sheeprl_sps", "sps", "{:.0f}"),
         ("sheeprl_env_steps_per_sec", "env-sps", "{:.0f}"),
         ("sheeprl_fetch_amortization", "fetch-amort", "{:.0f}x"),
+        # offline mode: the dataset feed replaces env throughput
+        ("sheeprl_dataset_read_sps", "dataset-sps", "{:.0f}"),
+        ("sheeprl_dataset_epoch", "epoch", "{:.0f}"),
         ("sheeprl_tflops_per_sec", "tflops", "{:.2f}"),
         ("sheeprl_mfu", "mfu", "{:.1%}"),
         ("sheeprl_goodput", "goodput", "{:.1%}"),
