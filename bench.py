@@ -1403,8 +1403,52 @@ def measure_serving(
             "errors": sum(client_errors),
         }
 
+    def overload_point(offered: int = 32, queue_limit: int = 4) -> dict:
+        """Load shedding at the door (ISSUE 16): shrink the request queue,
+        slow the dispatcher with its test seam, offer more concurrent
+        requests than slots and count the 503s.  Shed requests carry the
+        batcher's advisory ``Retry-After`` (seconds) — reported so the
+        overload contract is visible in the bench artifact."""
+        before = service.batcher.stats()
+        old_queue = service.batcher.max_queue
+        service.batcher.max_queue = int(queue_limit)
+        service._step_delay_s = 0.05
+        obs = {"state": np.linspace(-1, 1, obs_dim).tolist()}
+        lock = threading.Lock()
+        outcome = {"ok": 0, "shed": 0, "retry_after": []}
+
+        def client() -> None:
+            try:
+                service.act(obs, timeout_s=10.0)
+                with lock:
+                    outcome["ok"] += 1
+            except Exception as err:  # noqa: BLE001 — 503s are the point
+                with lock:
+                    outcome["shed"] += 1
+                    retry_after = getattr(err, "retry_after", None)
+                    if retry_after is not None:
+                        outcome["retry_after"].append(retry_after)
+
+        threads = [threading.Thread(target=client) for _ in range(int(offered))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        service._step_delay_s = None
+        service.batcher.max_queue = old_queue
+        after = service.batcher.stats()
+        return {
+            "offered": int(offered),
+            "queue_limit": int(queue_limit),
+            "accepted": outcome["ok"],
+            "shed_503": outcome["shed"],
+            "shed_total_delta": after["shed_total"] - before["shed_total"],
+            "retry_after_s": sorted(set(outcome["retry_after"])) or None,
+        }
+
     try:
         points = [swarm(int(n)) for n in loads]
+        overload = overload_point()
     finally:
         httpd.shutdown()
         httpd.server_close()
@@ -1415,6 +1459,7 @@ def measure_serving(
         "max_delay_ms": float(max_delay_ms),
         "compiles": service.compile_count,
         "points": points,
+        "overload": overload,
     }
 
 

@@ -336,6 +336,7 @@ def status_block(events: List[Dict[str, Any]]) -> str:
     lines.extend(isolation_status_lines(events, live=run_end is None))
     lines.extend(health_status_lines(events, live=run_end is None))
     lines.extend(memory_status_lines(events))
+    lines.extend(serving_status_lines(events, live=run_end is None))
     return "\n".join(lines)
 
 
@@ -614,6 +615,75 @@ def memory_status_lines(events: List[Dict[str, Any]]) -> List[str]:
             f"moves   {n_xfer} host transfers · {n_miss} donation-miss leaves · "
             f"{n_flagged} flagged replicated · {n_oom} ooms"
         )
+    return lines
+
+
+def sessions_full_banner(active: Any, capacity: Any) -> Optional[str]:
+    """The ``!! SESSIONS-FULL`` banner line (or None): ONE owner for the
+    threshold/wording so run_monitor's journal and endpoint modes can never
+    drift.  Fires when the session slab is at capacity — every additional
+    NEW session now evicts a resident one (journaled ``session_evict``) and
+    the evictee replays its episode from a reset state if it comes back."""
+    if not isinstance(active, (int, float)) or not isinstance(capacity, (int, float)):
+        return None
+    if capacity <= 0 or active < capacity:
+        return None
+    return (
+        f"!! SESSIONS-FULL — {active:.0f}/{capacity:.0f} session slots resident; "
+        "every new session evicts the LRU one (raise serving.sessions.capacity)"
+    )
+
+
+def serving_status_lines(events: List[Dict[str, Any]], live: bool = True) -> List[str]:
+    """The serving panel (run_monitor's journal mode + journal_report share
+    it): resident models with their last promoted step, session-layer
+    counters, request-log rotation totals, and — live mode only — the
+    ``!! SESSIONS-FULL`` banner off the latest metrics heartbeat's
+    ``Telemetry/sessions/*`` gauges.  Empty for journals that never served
+    (training runs)."""
+    serve_start = next((e for e in reversed(events) if e.get("event") == "serve_start"), None)
+    if serve_start is None:
+        return []
+    models = list(serve_start.get("models") or [])
+    if not models:
+        models = ["default"]
+    promotes = [e for e in events if e.get("event") == "ckpt_promote"]
+    rejects = [e for e in events if e.get("event") == "ckpt_reject"]
+    lines: List[str] = []
+    parts = [f"{len(models)} model{'s' if len(models) != 1 else ''}"]
+    for name in models:
+        step = next(
+            (e.get("step") for e in reversed(promotes) if (e.get("model") or "default") == name),
+            serve_start.get("ckpt_step") if name == (serve_start.get("model") or "default") else None,
+        )
+        parts.append(f"{name}@{step if step is not None else '?'}")
+    if promotes or rejects:
+        parts.append(f"{len(promotes)} promotes · {len(rejects)} rejects")
+    lines.append("serving " + " · ".join(parts))
+    evicts = [e for e in events if e.get("event") == "session_evict"]
+    rotations = [e for e in events if e.get("event") == "request_log_rotate"]
+    metrics_events = [e for e in events if e.get("event") == "metrics"]
+    last = (metrics_events[-1].get("metrics") or {}) if metrics_events else {}
+    active = last.get("Telemetry/sessions/active")
+    capacity = last.get("Telemetry/sessions/capacity")
+    if evicts or isinstance(active, (int, float)):
+        session_parts = []
+        if isinstance(active, (int, float)):
+            cap_s = f"/{capacity:.0f}" if isinstance(capacity, (int, float)) else ""
+            session_parts.append(f"{active:.0f}{cap_s} active")
+        session_parts.append(f"{len(evicts)} evictions")
+        lines.append("session " + " · ".join(session_parts))
+    if rotations:
+        rows = sum(int(e.get("rows") or 0) for e in rotations if not e.get("dropped"))
+        dropped = sum(int(e.get("rows") or 0) for e in rotations if e.get("dropped"))
+        log_line = f"reqlog  {len(rotations)} shards · {rows} rows logged"
+        if dropped:
+            log_line += f" · {dropped} rows DROPPED (writer backlog)"
+        lines.append(log_line)
+    if live:
+        banner = sessions_full_banner(active, capacity)
+        if banner is not None:
+            lines.append(banner)
     return lines
 
 

@@ -62,6 +62,8 @@ EVENT_KINDS: Dict[str, str] = {
     "serve_start": "the policy server came up: algo, served checkpoint/step, bind address, batch buckets, watched dir",
     "ckpt_promote": "hot-reload promoted a new checkpoint (step, path, params version) — atomic swap, no recompile",
     "ckpt_reject": "hot-reload refused a checkpoint: health-gate anomalies, shape mismatch, or missing journal",
+    "session_evict": "serving session layer: the LRU session lost its state-slab slot to a new session (session, slot, model, resident count vs capacity)",
+    "request_log_rotate": "serving request log: one shard of /act traffic rotated to disk (model, stream, rows, bytes, shard path) — or dropped=true when the writer queue was full",
     "ckpt_begin": "a checkpoint write started (path, step, blocking flag, seconds queued behind the async writer)",
     "ckpt_end": "a checkpoint write finished: bytes, write ms, manifest verified — or status=failed with the error",
     "ckpt_skipped": "resume selection rejected a checkpoint (corrupt / truncated / unreadable / incomplete_group) with the reason",
@@ -184,4 +186,16 @@ METRICS: Dict[str, str] = {
     "sheeprl_serve_batch_width_mean": "serving: mean valid rows per dispatch (amortization factor)",
     "sheeprl_serve_ckpt_step": "serving: policy step of the currently served checkpoint",
     "sheeprl_serve_last_promote_rejected": "serving: 1 while the newest checkpoint candidate was rejected",
+    # stateful multi-model serving (session layer + model registry + request
+    # log; per-model series carry a {model="..."} label, the unlabeled sample
+    # is the cross-model aggregate)
+    "sheeprl_serve_shed_total": "serving: requests refused 503 at the door because the queue was full (load shedding; responses carry Retry-After)",
+    "sheeprl_serve_models": "serving: resident models on this server (the registry size)",
+    "sheeprl_serve_request_log_rows_total": "serving: /act rows appended to the offline request-log dataset",
+    "sheeprl_serve_request_log_shards_total": "serving: request-log shards rotated to disk (journaled request_log_rotate)",
+    "sheeprl_sessions_active": "serving sessions: client sessions currently resident in the state slab",
+    "sheeprl_sessions_capacity": "serving sessions: state-slab capacity (serving.sessions.capacity)",
+    "sheeprl_sessions_created_total": "serving sessions: sessions allocated a slab slot (first sight or post-eviction re-entry)",
+    "sheeprl_sessions_evictions_total": "serving sessions: LRU evictions journaled as session_evict",
+    "sheeprl_sessions_overflow_total": "serving sessions: new sessions that rode the scratch slot because every slot was pinned by their own batch",
 }

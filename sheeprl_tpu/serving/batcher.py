@@ -37,11 +37,14 @@ DEFAULT_BUCKETS: Tuple[int, ...] = (8, 16, 32, 64, 128)
 
 
 class ServeError(RuntimeError):
-    """Request-level failure with an HTTP status (the server maps it)."""
+    """Request-level failure with an HTTP status (the server maps it).
+    ``retry_after`` (seconds, load-shed 503s) becomes the ``Retry-After``
+    header so well-behaved clients back off instead of hammering."""
 
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str, retry_after: Optional[float] = None):
         super().__init__(message)
         self.status = int(status)
+        self.retry_after = None if retry_after is None else max(1, int(round(retry_after)))
 
 
 def pick_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -54,12 +57,22 @@ def pick_bucket(n: int, buckets: Sequence[int]) -> int:
 
 
 class _Request:
-    __slots__ = ("row", "greedy", "t_enqueue", "event", "result", "error", "abandoned")
+    __slots__ = ("row", "greedy", "t_enqueue", "event", "result", "error", "abandoned", "group_key")
 
-    def __init__(self, row: Dict[str, np.ndarray], greedy: bool, t_enqueue: float):
+    def __init__(
+        self,
+        row: Dict[str, np.ndarray],
+        greedy: bool,
+        t_enqueue: float,
+        group_key: Optional[Any] = None,
+    ):
         self.row = row
         self.greedy = bool(greedy)
         self.t_enqueue = t_enqueue
+        # rows sharing a non-None group_key never share a dispatch: the
+        # session layer keys this by session id so one batch gathers each
+        # session's state at most once (per-session FIFO stays exact)
+        self.group_key = group_key
         self.event = threading.Event()
         self.result: Optional[Dict[str, Any]] = None
         self.error: Optional[ServeError] = None
@@ -104,6 +117,7 @@ class DynamicBatcher:
         self.requests_total = 0
         self.responses_total = 0
         self.errors_total = 0
+        self.shed_total = 0
         self.dispatches_total = 0
         self.rows_total = 0
         self.width_hist: Dict[int, int] = {}
@@ -133,20 +147,32 @@ class DynamicBatcher:
             self._thread = None
 
     # -- client side -------------------------------------------------------
-    def submit(self, row: Dict[str, np.ndarray], greedy: bool, timeout_s: float = 30.0) -> Dict[str, Any]:
+    def submit(
+        self,
+        row: Dict[str, np.ndarray],
+        greedy: bool,
+        timeout_s: float = 30.0,
+        group_key: Optional[Any] = None,
+    ) -> Dict[str, Any]:
         """Enqueue one observation row; block until its batch dispatched.
 
         Returns ``{"action": np.ndarray, **dispatch_meta, "queued_ms": float}``.
-        Raises :class:`ServeError` on overload (503), shutdown (503) or
+        Raises :class:`ServeError` on overload (503 + Retry-After: load is
+        shed at the door, never buffered unboundedly), shutdown (503) or
         timeout (504).
         """
-        req = _Request(row, greedy, self._clock())
+        req = _Request(row, greedy, self._clock(), group_key=group_key)
         with self._cond:
             if self._stop:
                 raise ServeError(503, "server shutting down")
             if len(self._queue) >= self.max_queue:
                 self.errors_total += 1
-                raise ServeError(503, f"request queue full ({self.max_queue})")
+                self.shed_total += 1
+                raise ServeError(
+                    503,
+                    f"request queue full ({self.max_queue})",
+                    retry_after=self._shed_retry_after_locked(),
+                )
             self.requests_total += 1
             self._queue.append(req)
             self._cond.notify_all()
@@ -184,22 +210,48 @@ class DynamicBatcher:
                 if self._stop:
                     return
                 group: List[_Request] = []
-                while self._queue and len(group) < self.max_batch and self._queue[0].greedy == head.greedy:
-                    group.append(self._queue.popleft())
+                taken: set = set()
+                while (
+                    self._queue
+                    and len(group) < self.max_batch
+                    and self._queue[0].greedy == head.greedy
+                    and (self._queue[0].group_key is None or self._queue[0].group_key not in taken)
+                ):
+                    req = self._queue.popleft()
+                    if req.group_key is not None:
+                        taken.add(req.group_key)
+                    group.append(req)
             self._dispatch_group(group)
 
     def _group_len(self) -> int:
-        """Contiguous head run with one greedy flag (a mixed queue dispatches
-        the head mode first; the rest re-queue naturally)."""
+        """Contiguous head run with one greedy flag and unique non-None group
+        keys (a mixed queue dispatches the head mode first; a repeated
+        session stays queued — per-session order is exact FIFO)."""
         if not self._queue:
             return 0
         flag = self._queue[0].greedy
+        taken: set = set()
         n = 0
         for req in self._queue:
             if req.greedy != flag or n >= self.max_batch:
                 break
+            if req.group_key is not None:
+                if req.group_key in taken:
+                    break
+                taken.add(req.group_key)
             n += 1
         return n
+
+    def _shed_retry_after_locked(self) -> float:
+        """Advisory Retry-After for a shed request: the time the current
+        backlog needs to drain at the observed service rate, floored at 1s.
+        Caller holds ``_cond``."""
+        done = list(self._done_t)
+        if len(done) >= 2 and done[-1] > done[0]:
+            rate = (len(done) - 1) / (done[-1] - done[0])
+            if rate > 0:
+                return min(60.0, max(1.0, len(self._queue) / rate))
+        return 1.0
 
     def _dispatch_group(self, group: List[_Request]) -> None:
         try:
@@ -243,6 +295,7 @@ class DynamicBatcher:
                 "requests_total": self.requests_total,
                 "responses_total": self.responses_total,
                 "errors_total": self.errors_total,
+                "shed_total": self.shed_total,
                 "dispatches_total": self.dispatches_total,
                 "rows_total": self.rows_total,
                 "queue_depth": len(self._queue),

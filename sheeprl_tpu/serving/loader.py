@@ -9,11 +9,15 @@ actions`` step (greedy or stochastic) the service AOT-compiles per batch
 bucket, and how a *new* checkpoint's params are converted for a hot swap.
 
 Adapters exist for the feed-forward actor families — ``ppo`` / ``a2c`` (the
-shared PPO-style agent) and ``sac`` (the tanh-Gaussian actor).  Recurrent and
-model-based policies (``ppo_recurrent``, the Dreamer family) carry per-client
-state across steps, which a stateless request/response tier cannot batch
-without a session layer — :func:`build_policy` rejects them with a clear
-error instead of serving wrong actions.
+shared PPO-style agent) and ``sac`` (the tanh-Gaussian actor) — and, since
+the session layer (:mod:`sheeprl_tpu.serving.sessions`), for the stateful
+families too: ``ppo_recurrent`` (LSTM carry + previous actions) and
+``dreamer_v3`` (RSSM recurrent/stochastic state).  A stateful handle sets
+``stateful=True`` and exposes ``make_state_step`` — a pure
+``(params, state, obs, is_first, key) -> (actions, new_state)`` step whose
+``is_first`` reset handling is bit-identical to the training player; the
+service keeps the per-session state resident in a fixed-capacity device slab
+and gathers/scatters it around every dispatch (howto/serving.md "Sessions").
 
 The health gate mirrors ``tools/health_diff.py``'s machine check: a candidate
 checkpoint is promotable when the training run's journal (the ``version_N``
@@ -29,7 +33,7 @@ import os
 import re
 from dataclasses import dataclass, field
 from math import prod
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -119,20 +123,39 @@ class PolicyHandle:
     both modes share one signature).  ``assemble(rows, width)`` pads a request
     group to the bucket width — the padded rows are zeros and are sliced off
     before any response sees them.  ``load_params`` converts a *new*
-    checkpoint's ``state["agent"]`` for an atomic hot swap.
+    checkpoint's agent state (:func:`agent_state_from_checkpoint`) for an
+    atomic hot swap.
+
+    Stateful families (``stateful=True``) additionally carry ``state_spec``
+    (per-row recurrent-state arrays, same ``{key: (shape, dtype)}`` layout as
+    ``obs_spec``) and ``make_state_step(greedy)`` — a pure
+    ``(params, state, obs, is_first, key) -> (actions, new_state)`` where
+    ``state`` is a dict of ``[B, ...]`` arrays and ``is_first`` is ``[B, 1]``
+    float (1 resets that row to its initial state IN-GRAPH, so reset handling
+    compiles into the AOT executable and matches the training player exactly).
+    ``make_step`` is None for stateful handles — the service drives the
+    session slab path instead.
+
+    ``log_row`` (optional) maps a validated obs row to the per-key arrays the
+    request log stores — the seam that lets ``sac`` log the FLAT concatenated
+    ``observations`` key offline training expects.
     """
 
     algo: str
     obs_spec: Dict[str, Tuple[Tuple[int, ...], str]]
     action_shape: Tuple[int, ...]
     params: Any
-    make_step: Callable[[bool], Callable]
+    make_step: Optional[Callable[[bool], Callable]]
     assemble: Callable[[List[Dict[str, np.ndarray]], int], Any]
     validate: Callable[[Any], Dict[str, np.ndarray]]
     load_params: Callable[[Dict[str, Any]], Any]
     ckpt_path: str = ""
     ckpt_step: int = 0
     meta: Dict[str, Any] = field(default_factory=dict)
+    stateful: bool = False
+    state_spec: Dict[str, Tuple[Tuple[int, ...], str]] = field(default_factory=dict)
+    make_state_step: Optional[Callable[[bool], Callable]] = None
+    log_row: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None
 
     def zero_obs(self, width: int) -> Any:
         """A zeros slab at ``width`` (warmup compiles trace against this)."""
@@ -265,6 +288,11 @@ def _sac_handle(cfg, obs_space, action_space, agent_state) -> PolicyHandle:
 
         return step
 
+    def log_row(row: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        # the request log stores the FLAT concat the nets consumed — the
+        # 'observations' key offline sac/droq training requires
+        return {"observations": np.concatenate([row[k] for k in mlp_keys], axis=-1)}
+
     return PolicyHandle(
         algo="sac",
         obs_spec=obs_spec,
@@ -275,10 +303,203 @@ def _sac_handle(cfg, obs_space, action_space, agent_state) -> PolicyHandle:
         validate=_row_validator(obs_spec),
         load_params=_jnp_tree,
         meta={"is_continuous": True},
+        log_row=log_row,
     )
 
 
-SERVABLE_BUILDERS.update({"ppo": _ppo_like_handle, "a2c": _ppo_like_handle, "sac": _sac_handle})
+def _ppo_recurrent_handle(cfg, obs_space, action_space, agent_state) -> PolicyHandle:
+    """ppo_recurrent: the LSTM agent served statefully.  Per-session state is
+    ``{hx, cx, prev_actions}``; the step masks all three by ``1 - is_first``
+    BEFORE the apply — exactly the host-side reset the training player does
+    (``ppo_recurrent.py``: ``hx *= (1 - dones)`` etc.) — then advances one
+    sequence step and rebuilds ``prev_actions`` (one-hot per discrete head,
+    raw actions when continuous) for the next request."""
+    from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+
+    actions_dim, is_continuous, _ = _actions_dim(action_space)
+    agent, params, _ = build_agent(None, actions_dim, is_continuous, cfg, obs_space, agent_state)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    obs_spec: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+    for k in cnn_keys:
+        obs_spec[k] = (tuple(obs_space[k].shape), "float32")
+    for k in mlp_keys:
+        obs_spec[k] = ((int(prod(obs_space[k].shape)),), "float32")
+    hidden = int(cfg.algo.rnn.lstm.hidden_size)
+    act_sum = int(sum(actions_dim))
+    state_spec = {
+        "hx": ((hidden,), "float32"),
+        "cx": ((hidden,), "float32"),
+        "prev_actions": ((act_sum,), "float32"),
+    }
+
+    def make_state_step(greedy: bool) -> Callable:
+        import jax
+        import jax.numpy as jnp
+
+        def step(p, state, obs, is_first, key):
+            keep = 1.0 - is_first  # [B, 1]; 1 -> fresh episode, zero the carry
+            hx = state["hx"] * keep
+            cx = state["cx"] * keep
+            prev_actions = state["prev_actions"] * keep
+            seq_obs = {k: v[None] for k, v in obs.items()}  # [1, B, ...]
+            actions, _, _, _, (new_hx, new_cx) = agent.apply(
+                p, seq_obs, prev_actions[None], hx, cx, key=key, greedy=greedy
+            )
+            actions_row = actions[0]  # [B, out]
+            if is_continuous:
+                next_prev = actions_row
+            else:
+                next_prev = jnp.concatenate(
+                    [
+                        jax.nn.one_hot(actions_row[:, j].astype(jnp.int32), d)
+                        for j, d in enumerate(actions_dim)
+                    ],
+                    axis=-1,
+                )
+            return actions_row, {"hx": new_hx, "cx": new_cx, "prev_actions": next_prev}
+
+        return step
+
+    action_shape = (sum(actions_dim),) if is_continuous else (len(actions_dim),)
+    return PolicyHandle(
+        algo="ppo_recurrent",
+        obs_spec=obs_spec,
+        action_shape=action_shape,
+        params=params,
+        make_step=None,
+        assemble=_dict_assembler(obs_spec),
+        validate=_row_validator(obs_spec),
+        load_params=_jnp_tree,
+        meta={"is_continuous": is_continuous, "actions_dim": list(actions_dim)},
+        stateful=True,
+        state_spec=state_spec,
+        make_state_step=make_state_step,
+    )
+
+
+def _dreamer_v3_handle(cfg, obs_space, action_space, agent_state) -> PolicyHandle:
+    """dreamer_v3: the world-model policy served statefully.  Per-session
+    state is the RSSM triplet ``{recurrent, stochastic, actions}``; resets
+    blend the (learnable, params-dependent) initial state in by the
+    ``is_first`` mask — the same masked blend as ``PlayerDV3._reset_masked``
+    — and the step mirrors ``PlayerDV3._step`` op for op (encode ->
+    recurrent_step -> representation -> actor.act).  Image keys travel as
+    raw uint8 and are scaled in-graph exactly like ``prepare_obs``."""
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+
+    actions_dim, is_continuous, _ = _actions_dim(action_space)
+    state_dict = dict(agent_state or {})
+    wm_def, actor_def, _, params = build_agent(
+        None,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        state_dict.get("world_model"),
+        state_dict.get("actor"),
+        state_dict.get("critic"),
+        state_dict.get("target_critic"),
+    )
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    obs_spec: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+    for k in cnn_keys:
+        obs_spec[k] = (tuple(obs_space[k].shape), "uint8")
+    for k in mlp_keys:
+        obs_spec[k] = ((int(prod(obs_space[k].shape)),), "float32")
+    wm_cfg = cfg.algo.world_model
+    recurrent_size = int(wm_cfg.recurrent_model.recurrent_state_size)
+    stochastic_size = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    act_sum = int(sum(actions_dim))
+    state_spec = {
+        "recurrent": ((recurrent_size,), "float32"),
+        "stochastic": ((stochastic_size,), "float32"),
+        "actions": ((act_sum,), "float32"),
+    }
+
+    def make_state_step(greedy: bool) -> Callable:
+        import jax
+        import jax.numpy as jnp
+
+        def step(p, state, obs, is_first, key):
+            wm_params, actor_params = p["world_model"], p["actor"]
+            n = is_first.shape[0]
+            h0, z0 = wm_def.apply(wm_params, (n,), method="initial_states")
+            init = {
+                "recurrent": h0,
+                "stochastic": z0,
+                "actions": jnp.zeros((n, act_sum), jnp.float32),
+            }
+            st = jax.tree_util.tree_map(
+                lambda i, s: is_first * i + (1.0 - is_first) * s, init, state
+            )
+            prepared = {}
+            for k in cnn_keys:
+                prepared[k] = obs[k].astype(jnp.float32) / 255.0 - 0.5
+            for k in mlp_keys:
+                prepared[k] = obs[k]
+            k1, k2 = jax.random.split(key)
+            embedded = wm_def.apply(wm_params, prepared, method="encode")
+            recurrent = wm_def.apply(
+                wm_params, st["stochastic"], st["actions"], st["recurrent"], method="recurrent_step"
+            )
+            if wm_def.decoupled_rssm:
+                _, stochastic = wm_def.apply(wm_params, None, embedded, k1, method="representation")
+            else:
+                _, stochastic = wm_def.apply(wm_params, recurrent, embedded, k1, method="representation")
+            latent = jnp.concatenate([stochastic, recurrent], axis=-1)
+            actions = actor_def.apply(actor_params, latent, k2, greedy, None, method="act")
+            return actions, {"recurrent": recurrent, "stochastic": stochastic, "actions": actions}
+
+        return step
+
+    # dreamer actions are the actor's raw output: the one-hot concat for
+    # discrete heads (clients argmax per head, like algos/dreamer_v3/utils.py
+    # ``test()``), the squashed continuous vector otherwise
+    return PolicyHandle(
+        algo="dreamer_v3",
+        obs_spec=obs_spec,
+        action_shape=(act_sum,),
+        params=params,
+        make_step=None,
+        assemble=_dict_assembler(obs_spec),
+        validate=_row_validator(obs_spec),
+        load_params=_jnp_tree,
+        meta={"is_continuous": is_continuous, "actions_dim": list(actions_dim)},
+        stateful=True,
+        state_spec=state_spec,
+        make_state_step=make_state_step,
+    )
+
+
+SERVABLE_BUILDERS.update(
+    {
+        "ppo": _ppo_like_handle,
+        "a2c": _ppo_like_handle,
+        "sac": _sac_handle,
+        "ppo_recurrent": _ppo_recurrent_handle,
+        "dreamer_v3": _dreamer_v3_handle,
+    }
+)
+
+#: checkpoint keys that make up a Dreamer-family agent state (those runs
+#: checkpoint each module separately instead of one "agent" tree)
+DREAMER_STATE_KEYS = ("world_model", "actor", "critic", "target_critic")
+
+
+def agent_state_from_checkpoint(state: Mapping[str, Any]) -> Dict[str, Any]:
+    """The servable agent state inside a loaded checkpoint: ``state["agent"]``
+    for the single-tree families, the per-module dict for the Dreamer family
+    (``world_model``/``actor``/...)."""
+    if "agent" in state:
+        return state["agent"]
+    if "world_model" in state:
+        return {k: state[k] for k in DREAMER_STATE_KEYS if k in state}
+    raise ValueError(
+        f"checkpoint has no servable agent state (keys: {sorted(state)}); expected "
+        f"'agent' or the Dreamer module keys {list(DREAMER_STATE_KEYS)}"
+    )
 
 
 def build_policy(cfg, obs_space, action_space, agent_state: Optional[Dict[str, Any]] = None) -> PolicyHandle:
@@ -289,9 +510,10 @@ def build_policy(cfg, obs_space, action_space, agent_state: Optional[Dict[str, A
     builder = SERVABLE_BUILDERS.get(algo)
     if builder is None:
         raise ValueError(
-            f"Algorithm {algo!r} is not servable: the stateless batching tier supports "
-            f"{sorted(SERVABLE_BUILDERS)} (recurrent/model-based policies carry per-client "
-            "state a request/response API cannot batch)"
+            f"Algorithm {algo!r} has no servable adapter; registered builders: "
+            f"{sorted(SERVABLE_BUILDERS)}.  Stateless actors register a plain "
+            "make_step handle; recurrent/model-based families register a stateful "
+            "handle served through the session layer (howto/serving.md 'Sessions')"
         )
     return builder(cfg, obs_space, action_space, agent_state)
 
@@ -306,8 +528,10 @@ def load_policy(cfg, ckpt_path: str) -> PolicyHandle:
     from sheeprl_tpu.utils.checkpoint import load_state
 
     state = load_state(str(ckpt_path))
-    if "agent" not in state:
-        raise ValueError(f"Checkpoint '{ckpt_path}' has no 'agent' state to serve")
+    try:
+        agent_state = agent_state_from_checkpoint(state)
+    except ValueError as err:
+        raise ValueError(f"Checkpoint '{ckpt_path}': {err}") from None
     cfg.env.capture_video = False
     env = make_env(cfg, cfg.seed, 0, None, "serve")()
     try:
@@ -317,7 +541,7 @@ def load_policy(cfg, ckpt_path: str) -> PolicyHandle:
         env.close()
     if not isinstance(obs_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation space (need a Dict): {obs_space}")
-    handle = build_policy(cfg, obs_space, action_space, state["agent"])
+    handle = build_policy(cfg, obs_space, action_space, agent_state)
     handle.ckpt_path = str(ckpt_path)
     handle.ckpt_step = checkpoint_step(ckpt_path) or 0
     return handle

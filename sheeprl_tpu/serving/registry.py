@@ -1,0 +1,192 @@
+"""The model registry: N resident policies on one serving mesh (ISSUE 16).
+
+One :class:`ServeApp` process can hold several :class:`PolicyHandle`s — a
+canary next to the stable policy, a Dreamer agent next to its SAC distiller —
+each with its OWN service (params, AOT executable cache keyed per
+``(model, bucket, mode)`` by construction, dynamic batcher, session slab,
+request log) and its own checkpoint watcher + health gate, all journaling
+into the one serving journal with a ``model`` field.  ``/act`` routes on the
+request's ``model`` field (absent -> the default model); ``/metrics`` renders
+every ``sheeprl_serve_*`` / ``sheeprl_sessions_*`` family twice — per-model
+labeled series (``{model="..."}``) for dashboards, plus an unlabeled
+aggregate so single-model tooling (run_monitor's serving panel) keeps
+working unchanged.
+
+Cross-model requests never share a dispatch — they run different params —
+so per-model batchers lose nothing; what IS shared is the process, the mesh
+and the journal.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+from sheeprl_tpu.serving.batcher import ServeError
+
+__all__ = ["ModelEntry", "ModelRegistry", "render_registry_metrics"]
+
+
+@dataclass
+class ModelEntry:
+    """Everything one resident model owns."""
+
+    name: str
+    service: Any  # PolicyService
+    handle: Any  # PolicyHandle
+    watcher: Any = None  # Optional[CheckpointWatcher]
+    request_log: Any = None  # Optional[RequestLog]
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+class ModelRegistry:
+    """Name -> :class:`ModelEntry`, with a default for model-less requests."""
+
+    def __init__(self) -> None:
+        self._entries: "Dict[str, ModelEntry]" = {}
+        self.default_name: Optional[str] = None
+
+    def add(self, entry: ModelEntry, default: bool = False) -> ModelEntry:
+        if entry.name in self._entries:
+            raise ValueError(f"model {entry.name!r} is already registered")
+        self._entries[entry.name] = entry
+        if default or self.default_name is None:
+            self.default_name = entry.name
+        return entry
+
+    def get(self, name: Optional[str] = None) -> ModelEntry:
+        key = str(name) if name else self.default_name
+        entry = self._entries.get(key) if key else None
+        if entry is None:
+            raise ServeError(
+                404, f"unknown model {name!r}; resident models: {sorted(self._entries)}"
+            )
+        return entry
+
+    def names(self) -> List[str]:
+        return sorted(self._entries)
+
+    def entries(self) -> List[ModelEntry]:
+        return [self._entries[n] for n in self.names()]
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    @property
+    def default(self) -> ModelEntry:
+        return self.get(None)
+
+
+# ---------------------------------------------------------------------------
+# /metrics rendering
+# ---------------------------------------------------------------------------
+
+#: gauge families whose unlabeled aggregate SUMS across models; the rest
+#: aggregate by max (latencies: the worst model is the honest headline)
+_SUM_GAUGES = {
+    "serve_queue_depth",
+    "serve_requests_per_sec",
+    "sessions_active",
+    "sessions_capacity",
+}
+
+
+def render_registry_metrics(registry: ModelRegistry) -> str:
+    """Prometheus text for every resident model: one ``# TYPE`` line per
+    family (a second TYPE line for the same name is a parse error), then the
+    ``{model="..."}`` series, then the unlabeled aggregate LAST so a naive
+    last-wins parser reads the fleet total."""
+    from sheeprl_tpu.diagnostics.metrics_server import (
+        METRIC_PREFIX,
+        _escape_label,
+        _metric_name,
+    )
+
+    entries = registry.entries()
+    snaps = {e.name: e.service.snapshot() for e in entries}
+    default_snap = snaps.get(registry.default_name) or next(iter(snaps.values()), {})
+    lines: List[str] = []
+
+    info = dict(default_snap.get("info") or {})
+    info["models"] = ",".join(registry.names())
+    lines.append("# HELP sheeprl_run_info Run identity (labels carry the data; value is 1).")
+    lines.append("# TYPE sheeprl_run_info gauge")
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in sorted(info.items()) if v is not None)
+    lines.append(f"sheeprl_run_info{{{inner}}} 1")
+    lines.append("# TYPE sheeprl_up gauge")
+    lines.append("sheeprl_up 1")
+    lines.append("# TYPE sheeprl_serve_models gauge")
+    lines.append(f"sheeprl_serve_models {len(registry)}")
+
+    def _family(kind: str, name: str, per_model: Dict[str, float], aggregate: float) -> None:
+        full = METRIC_PREFIX + name
+        lines.append(f"# TYPE {full} {kind}")
+        for model in sorted(per_model):
+            lines.append(f'{full}{{model="{_escape_label(model)}"}} {per_model[model]:g}')
+        lines.append(f"{full} {aggregate:g}")
+
+    def _num(value: Any) -> Optional[float]:
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return None
+
+    # gauges (Telemetry/... keys -> exported names)
+    gauge_names: List[str] = []
+    gauge_values: Dict[str, Dict[str, float]] = {}
+    for model, snap in snaps.items():
+        for key, value in (snap.get("gauges") or {}).items():
+            num = _num(value)
+            if num is None:
+                continue
+            name = _metric_name(key)
+            if name not in gauge_values:
+                gauge_values[name] = {}
+                gauge_names.append(name)
+            gauge_values[name][model] = num
+    for name in sorted(gauge_names):
+        per_model = gauge_values[name]
+        if name == "serve_ckpt_step":
+            aggregate = per_model.get(registry.default_name, max(per_model.values()))
+        elif name in _SUM_GAUGES:
+            aggregate = sum(per_model.values())
+        else:
+            aggregate = max(per_model.values())
+        _family("gauge", name, per_model, aggregate)
+
+    # counters (sum-aggregated by definition)
+    counter_names: List[str] = []
+    counter_values: Dict[str, Dict[str, float]] = {}
+    for model, snap in snaps.items():
+        for key, value in (snap.get("counters") or {}).items():
+            num = _num(value)
+            if num is None:
+                continue
+            if key not in counter_values:
+                counter_values[key] = {}
+                counter_names.append(key)
+            counter_values[key][model] = num
+    for name in sorted(counter_names):
+        per_model = counter_values[name]
+        _family("counter", name, per_model, sum(per_model.values()))
+
+    # the batch-width histogram: {model, width} series + width-only aggregate
+    width_totals: Dict[int, float] = {}
+    width_lines: List[str] = []
+    for model in sorted(snaps):
+        hist = snaps[model].get("batch_width_hist") or {}
+        for width, count in sorted(hist.items()):
+            width_lines.append(
+                f'sheeprl_serve_batch_width_total{{model="{_escape_label(model)}",width="{int(width)}"}} '
+                f"{int(count)}"
+            )
+            width_totals[int(width)] = width_totals.get(int(width), 0) + int(count)
+    if width_lines:
+        lines.append("# TYPE sheeprl_serve_batch_width_total counter")
+        lines.extend(width_lines)
+        for width, count in sorted(width_totals.items()):
+            lines.append(f'sheeprl_serve_batch_width_total{{width="{width}"}} {count:g}')
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,199 @@
+"""Request logging: every dispatched ``/act`` batch becomes offline training
+data (ISSUE 16, ROADMAP item 2c).
+
+Production traffic is the one dataset a deployed policy is guaranteed to be
+on-distribution for — and the serving tier used to throw it away.
+:class:`RequestLog` appends every dispatched row (observations as the policy
+consumed them, the action it returned, an ``is_first`` episode marker from
+the session layer) to a per-model sharded dataset stream in the exact
+``data/datasets.py`` format: ``shard-*.npz`` + manifest sidecars, rotated at
+``serving.request_log.rotate_rows`` rows (journaled ``request_log_rotate``),
+with the action-space metadata (``actions_dim`` / ``is_continuous`` / algo /
+checkpoint) recorded in ``dataset.json`` at collect time — so
+``OfflineDataset`` opens the log directly and ``algo.offline`` training
+consumes it with zero conversion (the production flywheel:
+howto/offline_rl.md).
+
+Rewards and ``terminated`` are zeros at collect time: the serving tier does
+not see returns.  Label them downstream (relabeling, human feedback, env
+re-simulation) or train reward-free components; the keys exist so the flat
+offline loaders accept the dataset as-is.
+
+Shard writes (npz + sha256) run on a background writer thread — the
+dispatcher only appends to a host-side buffer, so logging never stalls a
+batch.  ``close`` drains the writer and flushes the tail rows.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from sheeprl_tpu.data.datasets import shard_name, write_dataset_meta, write_shard
+
+__all__ = ["RequestLog"]
+
+
+class RequestLog:
+    """One model's ``/act`` traffic as a growing offline dataset stream."""
+
+    def __init__(
+        self,
+        root: str,
+        handle: Any,
+        model: Optional[str] = None,
+        rotate_rows: int = 4096,
+        journal: Any = None,
+        stream: int = 0,
+        extra_meta: Optional[Mapping[str, Any]] = None,
+    ):
+        self.root = str(root)
+        self.model = model
+        self.rotate_rows = max(1, int(rotate_rows))
+        self.stream = int(stream)
+        self._journal = journal
+        self._handle = handle
+        self._lock = threading.Lock()
+        self._buffer: List[Dict[str, np.ndarray]] = []
+        self._start = 0  # logical step cursor of the stream
+        self.rows_total = 0
+        self.shards_total = 0
+        self.dropped_total = 0
+        self._queue: "queue.Queue" = queue.Queue(maxsize=16)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._writer, name="sheeprl-request-log", daemon=True
+        )
+        os.makedirs(self.root, exist_ok=True)
+        meta: Dict[str, Any] = {
+            "source": "serving",
+            "model": model,
+            "algo": getattr(handle, "algo", None),
+            "ckpt": getattr(handle, "ckpt_path", None) or None,
+            "actions_dim": (getattr(handle, "meta", {}) or {}).get("actions_dim"),
+            "is_continuous": (getattr(handle, "meta", {}) or {}).get("is_continuous"),
+            "obs_keys": sorted(getattr(handle, "obs_spec", {}) or {}),
+        }
+        meta.update(dict(extra_meta or {}))
+        write_dataset_meta(self.root, meta)
+        self._thread.start()
+
+    # -- dispatcher side -----------------------------------------------------
+    def append(
+        self,
+        obs_rows: List[Dict[str, np.ndarray]],
+        actions: np.ndarray,
+        is_first: Optional[np.ndarray] = None,
+    ) -> None:
+        """Buffer one dispatched batch (valid rows only — padding already
+        sliced off).  ``is_first`` is ``[rows, 1]`` float from the session
+        layer (stateless dispatches log all-ones: each request is its own
+        one-step episode)."""
+        actions = np.asarray(actions)
+        log_row = getattr(self._handle, "log_row", None)
+        blocks: List[Dict[str, np.ndarray]] = []
+        for i, row in enumerate(obs_rows):
+            stored = dict(log_row(row)) if log_row is not None else dict(row)
+            stored["actions"] = np.asarray(actions[i], dtype=np.float32)
+            stored["rewards"] = np.zeros((1,), np.float32)
+            stored["terminated"] = np.zeros((1,), np.float32)
+            stored["is_first"] = (
+                np.ones((1,), np.float32)
+                if is_first is None
+                else np.asarray(is_first[i], np.float32).reshape(1)
+            )
+            blocks.append(stored)
+        full: Optional[List[Dict[str, np.ndarray]]] = None
+        with self._lock:
+            self._buffer.extend(blocks)
+            self.rows_total += len(blocks)
+            if len(self._buffer) >= self.rotate_rows:
+                full, self._buffer = self._buffer, []
+        if full:
+            self._enqueue(full)
+
+    def _enqueue(self, rows: List[Dict[str, np.ndarray]]) -> None:
+        try:
+            self._queue.put_nowait(rows)
+        except queue.Full:
+            # the disk cannot keep up: shed the oldest pending block rather
+            # than stall dispatches or grow without bound
+            with self._lock:
+                self.dropped_total += len(rows)
+            if self._journal is not None:
+                self._journal.write(
+                    "request_log_rotate",
+                    model=self.model,
+                    stream=self.stream,
+                    rows=len(rows),
+                    dropped=True,
+                )
+
+    # -- writer thread -------------------------------------------------------
+    def _writer(self) -> None:
+        while True:
+            try:
+                rows = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if rows is None:
+                return
+            try:
+                self._write_shard(rows)
+            except Exception:  # noqa: BLE001 - logging must outlive bad disks
+                with self._lock:
+                    self.dropped_total += len(rows)
+
+    def _write_shard(self, rows: List[Dict[str, np.ndarray]]) -> None:
+        arrays = {
+            k: np.stack([r[k] for r in rows], axis=0) for k in rows[0]
+        }
+        start = self._start
+        self._start += len(rows)
+        entry = write_shard(self.root, self.stream, start, arrays)
+        with self._lock:
+            self.shards_total += 1
+        if self._journal is not None:
+            self._journal.write(
+                "request_log_rotate",
+                model=self.model,
+                stream=self.stream,
+                rows=int(entry["rows"]),
+                bytes=int(entry["bytes"]),
+                start=int(entry["start"]),
+                path=shard_name(self.stream, start),
+                shards=self.shards_total,
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+    def flush(self) -> None:
+        """Rotate whatever is buffered (tests and close use this; a partial
+        shard is fine — shards only need a consistent time axis)."""
+        with self._lock:
+            rows, self._buffer = self._buffer, []
+        if rows:
+            self._enqueue(rows)
+
+    def close(self) -> None:
+        self.flush()
+        self._stop.set()
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass
+        self._thread.join(timeout=10)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "rows_total": self.rows_total,
+                "shards_total": self.shards_total,
+                "dropped_total": self.dropped_total,
+                "buffered": len(self._buffer),
+            }

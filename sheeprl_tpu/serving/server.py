@@ -1,25 +1,39 @@
 """The policy server: AOT-compiled batched inference + health-gated
 checkpoint hot-reload behind a stdlib HTTP tier.
 
-Three cooperating pieces, one process:
+Cooperating pieces, one process:
 
-* :class:`PolicyService` — owns the params (hot-swappable under a lock), the
-  per-``(bucket, mode)`` AOT executable cache, and the dispatch the batcher
-  drives: assemble the padded slab, snapshot params ONCE, run one compiled
-  device step, slice the valid rows.  ``promote`` swaps params atomically
-  between dispatches — same shapes hit the existing executables, so a
-  promotion never recompiles (a shape-changing checkpoint is rejected
-  instead of poisoning the cache);
+* :class:`PolicyService` — owns one model's params (hot-swappable under a
+  lock), the per-``(bucket, mode)`` AOT executable cache, and the dispatch
+  the batcher drives: assemble the padded slab, snapshot params ONCE, run
+  one compiled device step, slice the valid rows.  ``promote`` swaps params
+  atomically between dispatches — same shapes hit the existing executables,
+  so a promotion never recompiles (a shape-changing checkpoint is rejected
+  instead of poisoning the cache).  For stateful policies (``ppo_recurrent``
+  LSTM carries, ``dreamer_v3`` RSSM state) the service owns a
+  :class:`~sheeprl_tpu.serving.sessions.SessionStore`: recurrent state lives
+  in a device-resident slab, gathered/scattered inside the SAME compiled
+  step, keyed by the request's ``session`` id (SEED-RL's server-side state,
+  R2D2's stored-state discipline);
 * :class:`ServeApp` — ``ThreadingHTTPServer`` (the
   ``diagnostics/metrics_server.py`` pattern: handler threads only touch
   lock-protected state) serving ``POST /act``, ``GET /metrics`` (Prometheus
-  text, ``sheeprl_serve_*`` family) and ``GET /healthz``, plus the
-  checkpoint-directory watcher thread;
-* the watcher — polls the training run's checkpoint dir, gates every new
-  checkpoint on the run's health journal
+  text, ``sheeprl_serve_*`` / ``sheeprl_sessions_*`` families) and ``GET
+  /healthz``.  The app holds a :class:`~sheeprl_tpu.serving.registry.
+  ModelRegistry` of N resident models — ``/act`` routes on the request's
+  ``model`` field, each model has its own service/watcher/request log, and
+  ``/metrics`` renders per-model ``{model="..."}`` series plus unlabeled
+  aggregates;
+* the watcher — polls a training run's checkpoint dir PER MODEL, gates every
+  new checkpoint on the run's health journal
   (:func:`~sheeprl_tpu.serving.loader.checkpoint_health`) and journals the
-  decision as ``ckpt_promote`` / ``ckpt_reject`` in the serving run's own
-  reused :class:`~sheeprl_tpu.diagnostics.journal.RunJournal`.
+  decision as ``ckpt_promote`` / ``ckpt_reject`` (with a ``model`` field) in
+  the serving run's own reused
+  :class:`~sheeprl_tpu.diagnostics.journal.RunJournal`;
+* the request log — when ``serving.request_log.enabled``, every dispatched
+  batch is appended to a per-model offline dataset stream
+  (:class:`~sheeprl_tpu.serving.request_log.RequestLog`) that
+  ``OfflineDataset`` opens directly (howto/offline_rl.md).
 """
 
 from __future__ import annotations
@@ -36,13 +50,17 @@ import numpy as np
 from sheeprl_tpu.serving.batcher import DEFAULT_BUCKETS, DynamicBatcher, ServeError, pick_bucket
 from sheeprl_tpu.serving.loader import (
     PolicyHandle,
+    agent_state_from_checkpoint,
     checkpoint_health,
     checkpoint_step,
     latest_checkpoint,
     load_policy,
 )
+from sheeprl_tpu.serving.registry import ModelEntry, ModelRegistry, render_registry_metrics
+from sheeprl_tpu.serving.sessions import SessionStore, make_slab_step
 
 SERVE_GAUGE_PREFIX = "Telemetry/serve/"
+SESSIONS_GAUGE_PREFIX = "Telemetry/sessions/"
 
 
 class PolicyService:
@@ -53,6 +71,11 @@ class PolicyService:
     path the telemetry layer uses, donating the obs slab's device buffer on
     backends that support donation; ``aot=False`` calls the pure step
     directly (the test seam for host-side fake policies).
+
+    Stateful handles (``handle.stateful``) get a :class:`SessionStore`: the
+    compiled step becomes ``(params, state_slab, idx, obs, is_first, key) ->
+    (actions, new_slab)`` — gather, recurrent step and scatter fused into the
+    one device call, with the slab buffer donated alongside the obs slab.
     """
 
     def __init__(
@@ -61,11 +84,13 @@ class PolicyService:
         serving_cfg: Optional[Mapping[str, Any]] = None,
         journal: Any = None,
         aot: bool = True,
+        model: Optional[str] = None,
     ):
         cfg = dict(serving_cfg or {})
         self.handle = handle
         self._journal = journal
         self._aot = bool(aot)
+        self.model = model
         self.default_greedy = bool(cfg.get("greedy", True))
         buckets = cfg.get("batch_buckets") or list(DEFAULT_BUCKETS)
         self.buckets = tuple(sorted(int(b) for b in buckets))
@@ -75,6 +100,19 @@ class PolicyService:
             max_delay_ms=float(cfg.get("max_delay_ms", 5.0)),
             max_queue=int(cfg.get("max_queue", 4096)),
         )
+        self.sessions: Optional[SessionStore] = None
+        if getattr(handle, "stateful", False):
+            sessions_cfg = dict(cfg.get("sessions") or {})
+            self.sessions = SessionStore(
+                handle.state_spec,
+                capacity=int(sessions_cfg.get("capacity", 64)),
+                journal=journal,
+                model=model,
+                device=self._aot,
+            )
+        # set by ServeApp when serving.request_log.enabled; the dispatch
+        # appends every valid row after slicing off the padding
+        self.request_log: Any = None
         self._params_lock = threading.Lock()
         self._params = handle.params
         self._params_version = 0
@@ -96,6 +134,7 @@ class PolicyService:
             "algo": handle.algo,
             "role": "serve",
             "ckpt_path": self.ckpt_path or None,
+            "model": model,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -114,6 +153,9 @@ class PolicyService:
 
     def close(self) -> None:
         self.batcher.close()
+        if self.request_log is not None:
+            self.request_log.close()
+            self.request_log = None
 
     # -- the compiled step -------------------------------------------------
     def _compiled_step(self, width: int, greedy: bool) -> Callable:
@@ -125,25 +167,56 @@ class PolicyService:
             fn = self._compiled.get(key)
             if fn is not None:
                 return fn
-            pure = self.handle.make_step(bool(greedy))
-            if not self._aot:
-                compiled = pure
+            if self.sessions is not None:
+                compiled = self._build_stateful_step(int(width), bool(greedy))
             else:
-                import jax
-
-                # the obs slab is consumed by the step — donate its buffer
-                # where the backend supports donation (CPU does not; donating
-                # there only emits warnings)
-                donate = () if jax.default_backend() == "cpu" else (1,)
-                jitted = jax.jit(pure, donate_argnums=donate)
-                obs0 = self.handle.zero_obs(int(width))
-                key0 = jax.random.PRNGKey(0)
-                with self._params_lock:
-                    params = self._params
-                compiled = jitted.lower(params, obs0, key0).compile()
-                self.compile_count += 1
+                compiled = self._build_stateless_step(int(width), bool(greedy))
             self._compiled[key] = compiled
             return compiled
+
+    def _build_stateless_step(self, width: int, greedy: bool) -> Callable:
+        pure = self.handle.make_step(greedy)
+        if not self._aot:
+            return pure
+        import jax
+
+        # the obs slab is consumed by the step — donate its buffer where the
+        # backend supports donation (CPU does not; donating there only emits
+        # warnings)
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        jitted = jax.jit(pure, donate_argnums=donate)
+        obs0 = self.handle.zero_obs(width)
+        key0 = jax.random.PRNGKey(0)
+        with self._params_lock:
+            params = self._params
+        compiled = jitted.lower(params, obs0, key0).compile()
+        self.compile_count += 1
+        return compiled
+
+    def _build_stateful_step(self, width: int, greedy: bool) -> Callable:
+        assert self.sessions is not None
+        state_pure = self.handle.make_state_step(greedy)
+        if not self._aot:
+            # host path (fake-handle tests): the dispatcher gathers/scatters
+            # with numpy and calls the per-row step directly
+            return state_pure
+        import jax
+        import jax.numpy as jnp
+
+        pure = make_slab_step(state_pure)
+        # both the state slab (arg 1) and the obs slab (arg 3) are consumed:
+        # the scatter rebuilds the slab and the obs never outlive the step
+        donate = () if jax.default_backend() == "cpu" else (1, 3)
+        jitted = jax.jit(pure, donate_argnums=donate)
+        obs0 = self.handle.zero_obs(width)
+        idx0 = jnp.full((width,), self.sessions.scratch, dtype=jnp.int32)
+        isf0 = jnp.ones((width, 1), dtype=jnp.float32)
+        key0 = jax.random.PRNGKey(0)
+        with self._params_lock:
+            params = self._params
+        compiled = jitted.lower(params, self.sessions.slab, idx0, obs0, isf0, key0).compile()
+        self.compile_count += 1
+        return compiled
 
     def _next_key(self):
         import jax
@@ -153,8 +226,10 @@ class PolicyService:
         return jax.random.fold_in(self._base_key, self._dispatch_counter)
 
     # -- dispatch (called from the batcher thread) -------------------------
-    def _dispatch(self, rows: List[Dict[str, np.ndarray]], greedy: bool) -> Tuple[Any, Dict[str, Any]]:
+    def _dispatch(self, rows: List[Dict[str, Any]], greedy: bool) -> Tuple[Any, Dict[str, Any]]:
         width = pick_bucket(len(rows), self.buckets)
+        if self.sessions is not None:
+            return self._dispatch_stateful(rows, greedy, width)
         obs = self.handle.assemble(rows, width)
         # ONE params snapshot per dispatch: a concurrent promote() swaps the
         # reference for the NEXT dispatch; this batch is internally consistent
@@ -180,13 +255,97 @@ class PolicyService:
             "batch_rows": len(rows),
             "dispatch_id": self._dispatch_counter,
         }
-        return out[: len(rows)], meta
+        valid = out[: len(rows)]
+        if self.request_log is not None:
+            self.request_log.append(rows, valid)
+        return valid, meta
+
+    def _dispatch_stateful(
+        self, rows: List[Dict[str, Any]], greedy: bool, width: int
+    ) -> Tuple[Any, Dict[str, Any]]:
+        """One stateful dispatch: resolve each row's slab slot (LRU checkout
+        journals any eviction), then gather/step/scatter in the one compiled
+        call.  Padding and sessionless rows ride the scratch slot with
+        ``is_first`` forced to 1, so they can never read another session's
+        state."""
+        assert self.sessions is not None
+        obs_rows = [r["obs"] for r in rows]
+        obs = self.handle.assemble(obs_rows, width)
+        with self._params_lock:
+            params = self._params
+            version = self._params_version
+            step = self.ckpt_step
+        if self._step_delay_s:
+            time.sleep(self._step_delay_s)
+        self._dispatch_counter += 1
+        idx, is_first, _ = self.sessions.checkout(
+            [r.get("session") for r in rows], [bool(r.get("reset")) for r in rows], width
+        )
+        fn = self._compiled_step(width, greedy)
+        if self._aot:
+            import jax
+            import jax.numpy as jnp
+
+            key = self._next_key() if not greedy else jax.random.PRNGKey(0)
+            actions, new_slab = fn(
+                params, self.sessions.slab, jnp.asarray(idx), obs, jnp.asarray(is_first), key
+            )
+            self.sessions.slab = new_slab
+            out = np.asarray(actions)
+        else:
+            state = self.sessions.gather_np(idx)
+            actions, new_state = fn(params, state, obs, is_first, None)
+            self.sessions.scatter_np(idx, {k: np.asarray(v) for k, v in new_state.items()})
+            out = np.asarray(actions)
+        meta = {
+            "ckpt_step": step,
+            "params_version": version,
+            "batch_width": width,
+            "batch_rows": len(rows),
+            "dispatch_id": self._dispatch_counter,
+            "sessions_active": self.sessions.active,
+        }
+        valid = out[: len(rows)]
+        if self.request_log is not None:
+            self.request_log.append(obs_rows, valid, is_first[: len(rows)])
+        return valid, meta
 
     # -- request entry (called from HTTP handler threads) ------------------
-    def act(self, obs: Any, greedy: Optional[bool] = None, timeout_s: float = 30.0) -> Dict[str, Any]:
+    def act(
+        self,
+        obs: Any,
+        greedy: Optional[bool] = None,
+        timeout_s: float = 30.0,
+        session: Optional[str] = None,
+        reset: bool = False,
+    ) -> Dict[str, Any]:
         row = self.handle.validate(obs)
         use_greedy = self.default_greedy if greedy is None else bool(greedy)
-        return self.batcher.submit(row, use_greedy, timeout_s=timeout_s)
+        if self.sessions is None:
+            if session is not None:
+                raise ServeError(
+                    400,
+                    f"algorithm {self.handle.algo!r} serves statelessly; "
+                    "'session' is only valid for recurrent/model-based policies",
+                )
+            return self.batcher.submit(row, use_greedy, timeout_s=timeout_s)
+        sid = None if session is None else str(session)
+        # a non-None group key keeps one session's rows out of the same
+        # dispatch: its slab slot is gathered at most once per batch, so
+        # per-session ordering is exact FIFO (R2D2 stored-state discipline)
+        return self.batcher.submit(
+            {"obs": row, "session": sid, "reset": bool(reset)},
+            use_greedy,
+            timeout_s=timeout_s,
+            group_key=None if sid is None else ("session", sid),
+        )
+
+    def drop_session(self, session: str) -> bool:
+        """Explicit session release (``/act`` is fire-and-forget; LRU evicts
+        the forgetful)."""
+        if self.sessions is None:
+            return False
+        return self.sessions.drop(str(session))
 
     # -- hot reload --------------------------------------------------------
     def promote(self, params: Any, step: int, path: str, source: str = "watch") -> bool:
@@ -209,7 +368,7 @@ class PolicyService:
         if self._journal is not None:
             self._journal.write(
                 "ckpt_promote", step=int(step), path=str(path), source=source,
-                params_version=self._params_version,
+                params_version=self._params_version, model=self.model,
             )
         return True
 
@@ -222,6 +381,7 @@ class PolicyService:
                 step=checkpoint_step(path),
                 path=str(path),
                 reason=str(reason),
+                model=self.model,
                 anomalies=[
                     {"kind": e.get("kind"), "subject": e.get("subject"), "step": e.get("step")}
                     for e in (anomalies or [])
@@ -252,8 +412,8 @@ class PolicyService:
     # -- observability -----------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """Metrics-server-shaped snapshot: ``render_prometheus`` exports the
-        gauges/counters as the ``sheeprl_serve_*`` family (schema-registered
-        in ``diagnostics/schema.py``)."""
+        gauges/counters as the ``sheeprl_serve_*`` / ``sheeprl_sessions_*``
+        families (schema-registered in ``diagnostics/schema.py``)."""
         stats = self.batcher.stats()
         gauges: Dict[str, Any] = {
             SERVE_GAUGE_PREFIX + "queue_depth": stats["queue_depth"],
@@ -268,23 +428,37 @@ class PolicyService:
         ):
             if src in stats:
                 gauges[SERVE_GAUGE_PREFIX + name] = stats[src]
+        counters: Dict[str, Any] = {
+            "serve_requests_total": stats["requests_total"],
+            "serve_dispatches_total": stats["dispatches_total"],
+            "serve_request_errors_total": stats["errors_total"],
+            "serve_shed_total": stats["shed_total"],
+            "serve_ckpt_promotions_total": self.promotions_total,
+            "serve_ckpt_rejections_total": self.rejections_total,
+        }
+        if self.sessions is not None:
+            gauges[SESSIONS_GAUGE_PREFIX + "active"] = self.sessions.active
+            gauges[SESSIONS_GAUGE_PREFIX + "capacity"] = self.sessions.capacity
+            counters["sessions_created_total"] = self.sessions.created_total
+            counters["sessions_evictions_total"] = self.sessions.evictions_total
+            counters["sessions_overflow_total"] = self.sessions.overflow_total
+        if self.request_log is not None:
+            rl = self.request_log.stats()
+            counters["serve_request_log_rows_total"] = rl["rows_total"]
+            counters["serve_request_log_shards_total"] = rl["shards_total"]
         return {
             "info": {k: v for k, v in self.info.items() if v is not None},
             "gauges": gauges,
-            "counters": {
-                "serve_requests_total": stats["requests_total"],
-                "serve_dispatches_total": stats["dispatches_total"],
-                "serve_request_errors_total": stats["errors_total"],
-                "serve_ckpt_promotions_total": self.promotions_total,
-                "serve_ckpt_rejections_total": self.rejections_total,
-            },
+            "counters": counters,
             "batch_width_hist": stats["width_hist"],
         }
 
 
 def render_serving_metrics(snapshot: Mapping[str, Any]) -> str:
-    """Prometheus text for a service snapshot: the shared renderer plus the
-    batch-width histogram as a labeled counter family."""
+    """Prometheus text for a SINGLE service snapshot: the shared renderer
+    plus the batch-width histogram as a labeled counter family.  The app's
+    ``/metrics`` endpoint renders the whole registry instead
+    (:func:`~sheeprl_tpu.serving.registry.render_registry_metrics`)."""
     from sheeprl_tpu.diagnostics.metrics_server import render_prometheus
 
     body = render_prometheus(snapshot)
@@ -311,7 +485,8 @@ class CheckpointWatcher(threading.Thread):
         journal: Any = None,
         journal_every_s: float = 10.0,
     ):
-        super().__init__(name="sheeprl-serve-watcher", daemon=True)
+        name = f"sheeprl-serve-watcher-{service.model}" if service.model else "sheeprl-serve-watcher"
+        super().__init__(name=name, daemon=True)
         self.service = service
         self.watch_dir = str(watch_dir)
         self.poll_s = max(0.05, float(poll_s))
@@ -387,7 +562,7 @@ class CheckpointWatcher(threading.Thread):
         from sheeprl_tpu.utils.checkpoint import load_state
 
         state = load_state(candidate)
-        params = self.service.handle.load_params(state["agent"])
+        params = self.service.handle.load_params(agent_state_from_checkpoint(state))
         promoted = self.service.promote(
             params, step if step is not None else self.service.ckpt_step, candidate
         )
@@ -414,18 +589,45 @@ def _serve_log_dir(cfg) -> str:
     return log_dir
 
 
+def _archived_model_cfg(app_cfg, ckpt_path: str):
+    """Compose one extra model's run config: its OWN archived ``config.yaml``
+    (the checkpoint dir's parent, same layout ``cli.serve`` reads) when
+    present, the app config otherwise — always with the app's ``serving``
+    block, so every resident model shares one batching/reload policy."""
+    import yaml
+
+    from sheeprl_tpu.utils.utils import dotdict
+
+    cfg_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(ckpt_path))), "config.yaml"
+    )
+    if os.path.isfile(cfg_path):
+        with open(cfg_path) as fp:
+            cfg = dotdict(yaml.safe_load(fp))
+    else:
+        cfg = dotdict(dict(app_cfg))
+    cfg["serving"] = dict(app_cfg.get("serving") or {})
+    return cfg
+
+
 class ServeApp:
-    """Everything the ``serve`` CLI runs: policy + service + HTTP + watcher.
+    """Everything the ``serve`` CLI runs: N policies + services + HTTP +
+    watchers.
 
     Built from a composed run config (the checkpoint's archived config with a
-    ``serving`` block merged in — ``cli.serve`` does that).  ``start``
-    returns the bound ``(host, port)``; tests drive it in-process.
+    ``serving`` block merged in — ``cli.serve`` does that).  The required
+    ``ckpt_path`` becomes the ``default`` model; ``serving.models`` — a
+    mapping of ``name: checkpoint_path`` (or ``name: {checkpoint_path,
+    watch_dir}``) — adds more residents, each with its own archived config,
+    watcher and request log.  ``start`` returns the bound ``(host, port)``;
+    tests drive it in-process.  ``app.service`` / ``app.handle`` /
+    ``app.watcher`` are the DEFAULT model's (single-model callers never see
+    the registry).
     """
 
     def __init__(self, cfg, ckpt_path: str, watch_dir: Optional[str] = None):
         self.cfg = cfg
         serving_cfg = dict(cfg.get("serving") or {})
-        reload_cfg = dict(serving_cfg.get("reload") or {})
         self.host = str(serving_cfg.get("host", "127.0.0.1"))
         self.port = int(serving_cfg.get("port", 0))
         self.request_timeout_s = float(serving_cfg.get("request_timeout_s", 30.0))
@@ -433,14 +635,60 @@ class ServeApp:
         from sheeprl_tpu.diagnostics.journal import JOURNAL_NAME, RunJournal
 
         self.journal = RunJournal(os.path.join(self.log_dir, JOURNAL_NAME))
-        self.handle = load_policy(cfg, ckpt_path)
-        self.service = PolicyService(self.handle, serving_cfg, journal=self.journal)
-        self.service.info["env"] = (cfg.get("env") or {}).get("id")
-        self.service.info["run_id"] = os.path.basename(self.log_dir)
-        self.watcher: Optional[CheckpointWatcher] = None
+        self.registry = ModelRegistry()
+        self._add_model("default", cfg, str(ckpt_path), watch_dir=watch_dir, default=True)
+        for name in sorted(serving_cfg.get("models") or {}):
+            spec = (serving_cfg.get("models") or {})[name]
+            if isinstance(spec, str):
+                extra_ckpt, extra_watch = spec, None
+            else:
+                spec = dict(spec or {})
+                extra_ckpt = spec.get("checkpoint_path")
+                extra_watch = spec.get("watch_dir")
+            if not extra_ckpt:
+                raise ValueError(f"serving.models.{name}: checkpoint_path is required")
+            self._add_model(
+                str(name),
+                _archived_model_cfg(cfg, str(extra_ckpt)),
+                str(extra_ckpt),
+                watch_dir=extra_watch,
+            )
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._warmup = bool(serving_cfg.get("warmup", True))
+
+    def _add_model(
+        self,
+        name: str,
+        cfg,
+        ckpt_path: str,
+        watch_dir: Optional[str] = None,
+        default: bool = False,
+    ) -> ModelEntry:
+        serving_cfg = dict(cfg.get("serving") or {})
+        reload_cfg = dict(serving_cfg.get("reload") or {})
+        handle = load_policy(cfg, ckpt_path)
+        service = PolicyService(handle, serving_cfg, journal=self.journal, model=name)
+        service.info["env"] = (cfg.get("env") or {}).get("id")
+        service.info["run_id"] = os.path.basename(self.log_dir)
+        request_log = None
+        rl_cfg = dict(serving_cfg.get("request_log") or {})
+        if rl_cfg.get("enabled"):
+            from sheeprl_tpu.serving.request_log import RequestLog
+
+            root = rl_cfg.get("dir") or os.path.join(self.log_dir, "requests")
+            request_log = RequestLog(
+                os.path.join(str(root), name),
+                handle,
+                model=name,
+                rotate_rows=int(rl_cfg.get("rotate_rows", 4096)),
+                journal=self.journal,
+            )
+            service.request_log = request_log
+        watcher = None
         if reload_cfg.get("enabled", True):
-            self.watcher = CheckpointWatcher(
-                self.service,
+            watcher = CheckpointWatcher(
+                service,
                 watch_dir or reload_cfg.get("watch_dir") or os.path.dirname(os.path.abspath(ckpt_path)),
                 poll_s=float(reload_cfg.get("poll_s", 2.0)),
                 health_gate=bool(reload_cfg.get("health_gate", True)),
@@ -448,25 +696,60 @@ class ServeApp:
                 journal=self.journal,
                 journal_every_s=float(serving_cfg.get("journal_every_s", 10.0)),
             )
-        self._server: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
-        self._warmup = bool(serving_cfg.get("warmup", True))
+        return self.registry.add(
+            ModelEntry(
+                name=name,
+                service=service,
+                handle=handle,
+                watcher=watcher,
+                request_log=request_log,
+                meta={"ckpt_path": str(ckpt_path)},
+            ),
+            default=default,
+        )
+
+    # single-model accessors: the default model's pieces (the shape every
+    # pre-registry caller and test knows)
+    @property
+    def service(self) -> PolicyService:
+        return self.registry.default.service
+
+    @property
+    def handle(self) -> PolicyHandle:
+        return self.registry.default.handle
+
+    @property
+    def watcher(self) -> Optional[CheckpointWatcher]:
+        return self.registry.default.watcher
+
+    @property
+    def request_log(self):
+        return self.registry.default.request_log
 
     def start(self) -> Tuple[str, int]:
-        service = self.service
+        registry = self.registry
         timeout_s = self.request_timeout_s
-        service.start()
-        if self._warmup:
-            service.warmup()
+        for entry in registry.entries():
+            entry.service.start()
+            if self._warmup:
+                entry.service.warmup()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt: str, *args: Any) -> None:  # silence stderr spam
                 pass
 
-            def _reply(self, status: int, body: bytes, content_type: str = "application/json") -> None:
+            def _reply(
+                self,
+                status: int,
+                body: bytes,
+                content_type: str = "application/json",
+                headers: Optional[Dict[str, str]] = None,
+            ) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for header, value in (headers or {}).items():
+                    self.send_header(header, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -477,13 +760,23 @@ class ServeApp:
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     payload = json.loads(self.rfile.read(length) or b"{}")
-                    result = service.act(
+                    entry = registry.get(payload.get("model"))
+                    result = entry.service.act(
                         payload.get("obs"),
                         greedy=payload.get("greedy"),
                         timeout_s=min(timeout_s, float(payload.get("timeout_s") or timeout_s)),
+                        session=payload.get("session"),
+                        reset=bool(payload.get("reset", False)),
                     )
                 except ServeError as err:
-                    self._reply(err.status, json.dumps({"error": str(err)}).encode())
+                    headers = (
+                        {"Retry-After": str(err.retry_after)}
+                        if err.retry_after is not None
+                        else None
+                    )
+                    self._reply(
+                        err.status, json.dumps({"error": str(err)}).encode(), headers=headers
+                    )
                     return
                 except (ValueError, TypeError, json.JSONDecodeError) as err:
                     self._reply(400, json.dumps({"error": str(err)}).encode())
@@ -505,21 +798,41 @@ class ServeApp:
 
                         self._reply(
                             200,
-                            render_serving_metrics(service.snapshot()).encode(),
+                            render_registry_metrics(registry).encode(),
                             PROMETHEUS_CONTENT_TYPE,
                         )
                     elif path == "/healthz":
-                        stats = service.batcher.stats()
+                        default = registry.default
+                        stats = default.service.batcher.stats()
+                        models: Dict[str, Any] = {}
+                        for entry in registry.entries():
+                            entry_stats = entry.service.batcher.stats()
+                            row: Dict[str, Any] = {
+                                "algo": entry.handle.algo,
+                                "ckpt_step": entry.service.ckpt_step,
+                                "ckpt_path": entry.service.ckpt_path,
+                                "requests_total": entry_stats["requests_total"],
+                                "last_promote_rejected": entry.service.last_promote_rejected,
+                                "stateful": bool(getattr(entry.handle, "stateful", False)),
+                            }
+                            if entry.service.sessions is not None:
+                                row["sessions"] = {
+                                    "active": entry.service.sessions.active,
+                                    "capacity": entry.service.sessions.capacity,
+                                    "evictions_total": entry.service.sessions.evictions_total,
+                                }
+                            models[entry.name] = row
                         self._reply(
                             200,
                             json.dumps(
                                 {
                                     "status": "ok",
-                                    "algo": service.handle.algo,
-                                    "ckpt_step": service.ckpt_step,
-                                    "ckpt_path": service.ckpt_path,
+                                    "algo": default.handle.algo,
+                                    "ckpt_step": default.service.ckpt_step,
+                                    "ckpt_path": default.service.ckpt_path,
                                     "requests_total": stats["requests_total"],
-                                    "last_promote_rejected": service.last_promote_rejected,
+                                    "last_promote_rejected": default.service.last_promote_rejected,
+                                    "models": models,
                                 }
                             ).encode(),
                         )
@@ -534,19 +847,22 @@ class ServeApp:
             target=self._server.serve_forever, name="sheeprl-serve-http", daemon=True
         )
         self._thread.start()
-        if self.watcher is not None:
-            self.watcher.start()
+        for entry in registry.entries():
+            if entry.watcher is not None:
+                entry.watcher.start()
         host, port = self._server.server_address[:2]
+        default = registry.default
         self.journal.write(
             "serve_start",
-            algo=self.handle.algo,
+            algo=default.handle.algo,
             env=(self.cfg.get("env") or {}).get("id"),
-            ckpt=self.service.ckpt_path,
-            ckpt_step=self.service.ckpt_step,
+            ckpt=default.service.ckpt_path,
+            ckpt_step=default.service.ckpt_step,
             host=str(host),
             port=int(port),
-            buckets=list(self.service.buckets),
-            watch_dir=self.watcher.watch_dir if self.watcher is not None else None,
+            buckets=list(default.service.buckets),
+            watch_dir=default.watcher.watch_dir if default.watcher is not None else None,
+            models=registry.names(),
         )
         return str(host), int(port)
 
@@ -557,9 +873,10 @@ class ServeApp:
         return str(host), int(port)
 
     def close(self, status: str = "completed") -> None:
-        if self.watcher is not None:
-            self.watcher.stop()
-            self.watcher = None
+        for entry in self.registry.entries():
+            if entry.watcher is not None:
+                entry.watcher.stop()
+                entry.watcher = None
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -567,7 +884,9 @@ class ServeApp:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        self.service.close()
+        for entry in self.registry.entries():
+            entry.service.close()  # closes the request log too
+            entry.request_log = None
         stats = self.service.batcher.stats()
         self.journal.write("metrics", step=stats["requests_total"], metrics=self.service.snapshot()["gauges"])
         self.journal.write("run_end", status=status)
@@ -579,9 +898,12 @@ def serve_checkpoint(cfg, ckpt_path: str, watch_dir: Optional[str] = None) -> No
     interrupted."""
     app = ServeApp(cfg, ckpt_path, watch_dir=watch_dir)
     host, port = app.start()
+    extra = ""
+    if len(app.registry) > 1:
+        extra = f" [models: {', '.join(app.registry.names())}]"
     print(
         f"Serving {app.handle.algo} checkpoint (step {app.service.ckpt_step}) "
-        f"at http://{host}:{port}/act  (metrics: /metrics, health: /healthz)",
+        f"at http://{host}:{port}/act  (metrics: /metrics, health: /healthz)" + extra,
         flush=True,
     )
     status = "completed"

@@ -1,0 +1,194 @@
+"""The serving session layer: device-resident recurrent state for stateful
+policies (ISSUE 16, ROADMAP item 2a).
+
+SEED-RL keeps recurrent state on the inference server so clients stay thin;
+R2D2's stored-state discipline says that state must travel WITH the policy
+step, never be re-derived.  :class:`SessionStore` implements both for the
+batching tier:
+
+* a fixed-capacity **state slab** — one ``[capacity + 1, ...]`` array per
+  ``state_spec`` key (the training-side RSSM slab idiom from
+  ``data/slab.py``), resident on device under AOT serving.  Row ``capacity``
+  is the **scratch slot**: padding rows and sessionless one-shot requests
+  gather/scatter there with ``is_first = 1`` forced, so whatever garbage the
+  slot holds is reset in-graph before it can influence an action — mixed
+  stateless+stateful batches can never cross-contaminate;
+* a host-side **LRU table** mapping client session ids to slots.  A new
+  session takes the lowest free slot (deterministic allocation ⇒
+  deterministic eviction order); when the slab is full the least-recently
+  used session NOT in the current batch is evicted with a journaled
+  ``session_evict``.  An evicted session that comes back is simply a new
+  session: fresh slot, ``is_first = 1``, re-initialized in-graph — the
+  re-init parity the golden tests pin.
+
+The dispatcher is the only writer of the slab (one batcher thread), so slab
+swaps need no lock; ``checkout`` runs under the table lock because HTTP
+handler threads never touch it — they only submit rows.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["SessionStore", "make_slab_step"]
+
+
+def make_slab_step(state_step: Callable) -> Callable:
+    """Wrap a pure per-row state step into the slab signature the service
+    AOT-compiles: ``(params, slab, idx, obs, is_first, key) -> (actions,
+    new_slab)``.  Gather, step and scatter fuse into ONE executable so a
+    stateful dispatch is still a single device call; the slab buffer is
+    donated on backends that support donation.
+
+    Duplicate indices only ever point at the scratch slot (the batcher's
+    session group-key keeps real sessions unique per batch), where
+    last-writer-wins scatter is harmless — scratch is reset before every use.
+    """
+    import jax
+
+    def step(params, slab, idx, obs, is_first, key):
+        state = jax.tree_util.tree_map(lambda x: x[idx], slab)
+        actions, new_state = state_step(params, state, obs, is_first, key)
+        new_slab = jax.tree_util.tree_map(
+            lambda s, n: s.at[idx].set(n.astype(s.dtype)), slab, new_state
+        )
+        return actions, new_slab
+
+    return step
+
+
+class SessionStore:
+    """Fixed-capacity session table + state slab (host or device arrays).
+
+    ``device=True`` keeps the slab as jax arrays for the AOT path;
+    ``device=False`` (the fake-handle test seam) keeps numpy and steps with
+    plain fancy indexing.
+    """
+
+    def __init__(
+        self,
+        state_spec: Dict[str, Tuple[Tuple[int, ...], str]],
+        capacity: int,
+        journal: Any = None,
+        model: Optional[str] = None,
+        device: bool = True,
+    ):
+        if capacity <= 0:
+            raise ValueError(f"sessions.capacity must be > 0, got {capacity}")
+        self.capacity = int(capacity)
+        self.scratch = self.capacity  # slot index of the scratch row
+        self.state_spec = dict(state_spec)
+        self._journal = journal
+        self.model = model
+        self._device = bool(device)
+        rows = self.capacity + 1
+        slab = {
+            k: np.zeros((rows,) + tuple(shape), dtype=dtype)
+            for k, (shape, dtype) in self.state_spec.items()
+        }
+        if self._device:
+            import jax.numpy as jnp
+
+            slab = {k: jnp.asarray(v) for k, v in slab.items()}
+        self.slab: Dict[str, Any] = slab
+        self._lru: "OrderedDict[str, int]" = OrderedDict()  # session id -> slot
+        self._free: List[int] = list(range(self.capacity))
+        self._lock = threading.Lock()
+        self.created_total = 0
+        self.evictions_total = 0
+        self.overflow_total = 0
+
+    # -- table --------------------------------------------------------------
+    def checkout(
+        self,
+        session_ids: Sequence[Optional[str]],
+        resets: Sequence[bool],
+        width: int,
+    ) -> Tuple[np.ndarray, np.ndarray, List[Dict[str, Any]]]:
+        """Resolve one batch: ``(idx [width] int32, is_first [width, 1]
+        float32, evicted records)``.  Padding rows map to scratch with
+        ``is_first = 1``; so do sessionless rows and — when every slot is
+        pinned by this very batch — overflow sessions (which then simply are
+        not resident yet; they allocate on a later dispatch)."""
+        idx = np.full((int(width),), self.scratch, dtype=np.int32)
+        is_first = np.ones((int(width), 1), dtype=np.float32)
+        evicted: List[Dict[str, Any]] = []
+        with self._lock:
+            busy = {self._lru[s] for s in session_ids if s is not None and s in self._lru}
+            for i, (sid, reset) in enumerate(zip(session_ids, resets)):
+                if sid is None:
+                    continue  # one-shot row: scratch + reset
+                slot = self._lru.get(sid)
+                if slot is None:
+                    slot = self._allocate(sid, busy, evicted)
+                    if slot is None:
+                        self.overflow_total += 1
+                        continue  # slab fully pinned by this batch: scratch
+                    busy.add(slot)
+                else:
+                    self._lru.move_to_end(sid)
+                    is_first[i, 0] = 1.0 if reset else 0.0
+                idx[i] = slot
+        for record in evicted:
+            if self._journal is not None:
+                self._journal.write("session_evict", **record)
+        return idx, is_first, evicted
+
+    def _allocate(
+        self, sid: str, busy: set, evicted: List[Dict[str, Any]]
+    ) -> Optional[int]:
+        """Lowest free slot, else evict the LRU session not pinned by the
+        current batch.  Caller holds the lock."""
+        if self._free:
+            slot = self._free.pop(0)
+        else:
+            victim = next((s for s in self._lru if self._lru[s] not in busy), None)
+            if victim is None:
+                return None
+            slot = self._lru.pop(victim)
+            self.evictions_total += 1
+            evicted.append(
+                {
+                    "session": victim,
+                    "slot": int(slot),
+                    "model": self.model,
+                    "resident": len(self._lru),
+                    "capacity": self.capacity,
+                }
+            )
+        self._lru[sid] = slot
+        self.created_total += 1
+        return slot
+
+    def drop(self, session_id: str) -> bool:
+        """Explicit release (client says goodbye); no eviction journal."""
+        with self._lock:
+            slot = self._lru.pop(session_id, None)
+            if slot is None:
+                return False
+            self._free.append(slot)
+            self._free.sort()
+            return True
+
+    @property
+    def active(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def sessions(self) -> List[str]:
+        with self._lock:
+            return list(self._lru)
+
+    # -- slab (dispatcher thread only) --------------------------------------
+    def gather_np(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v)[idx] for k, v in self.slab.items()}
+
+    def scatter_np(self, idx: np.ndarray, new_state: Dict[str, np.ndarray]) -> None:
+        for k, arr in self.slab.items():
+            arr = np.asarray(arr)
+            arr[idx] = np.asarray(new_state[k], dtype=arr.dtype)
+            self.slab[k] = arr

@@ -51,6 +51,67 @@ def make_fake_handle(obs_dim: int = 4, version: float = 1.0) -> PolicyHandle:
     )
 
 
+def make_fake_stateful_handle(obs_dim: int = 4, version: float = 1.0) -> PolicyHandle:
+    """A recurrent fake: per-session state is a step counter that ``is_first``
+    resets, so every action reveals WHICH params served it, HOW MANY steps its
+    session has accumulated since the last reset, and THAT its own row was
+    used — action = ``[params_scalar, steps_since_reset, row_sum]``."""
+    obs_spec = {"state": ((obs_dim,), "float32")}
+
+    def assemble(rows: List[Dict[str, np.ndarray]], width: int) -> np.ndarray:
+        buf = np.zeros((int(width), obs_dim), dtype=np.float32)
+        for i, row in enumerate(rows):
+            buf[i] = row["state"]
+        return buf
+
+    def make_state_step(greedy: bool):
+        def step(params, state, obs, is_first, key):
+            count = state["count"] * (1.0 - np.asarray(is_first, np.float32)) + 1.0
+            scalar = np.full_like(count, params["w"])
+            actions = np.concatenate(
+                [scalar, count, obs.sum(axis=-1, keepdims=True)], axis=-1
+            )
+            return actions, {"count": count}
+
+        return step
+
+    def validate(obs: Any) -> Dict[str, np.ndarray]:
+        if not isinstance(obs, dict) or "state" not in obs:
+            raise ValueError("obs must be a dict with a 'state' key")
+        arr = np.asarray(obs["state"], dtype=np.float32).reshape(-1)
+        if arr.size != obs_dim:
+            raise ValueError(f"state must have {obs_dim} elements")
+        return {"state": arr}
+
+    return PolicyHandle(
+        algo="fake_recurrent",
+        obs_spec=obs_spec,
+        action_shape=(3,),
+        params={"w": np.float32(version)},
+        make_step=None,
+        assemble=assemble,
+        validate=validate,
+        load_params=lambda state: {"w": np.float32(state["w"])},
+        meta={"is_continuous": False, "actions_dim": [3]},
+        stateful=True,
+        state_spec={"count": ((1,), "float32")},
+        make_state_step=make_state_step,
+    )
+
+
+class JournalStub:
+    """Captures ``RunJournal.write`` calls as plain dicts."""
+
+    def __init__(self) -> None:
+        self.events: List[Dict[str, Any]] = []
+
+    def write(self, kind: str, **fields: Any) -> None:
+        self.events.append({"event": kind, **fields})
+
+    def kinds(self) -> List[str]:
+        return [e["event"] for e in self.events]
+
+
 @pytest.fixture
 def fake_handle() -> PolicyHandle:
     return make_fake_handle()
@@ -62,3 +123,18 @@ def fake_handle_factory():
     dirs are not packages, so the factory travels as a fixture, not an
     import)."""
     return make_fake_handle
+
+
+@pytest.fixture
+def fake_stateful_handle() -> PolicyHandle:
+    return make_fake_stateful_handle()
+
+
+@pytest.fixture
+def fake_stateful_handle_factory():
+    return make_fake_stateful_handle
+
+
+@pytest.fixture
+def journal_stub() -> JournalStub:
+    return JournalStub()

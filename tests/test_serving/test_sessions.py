@@ -1,0 +1,389 @@
+"""The serving session layer, unit-tested on the host path (``aot=False``
+fake stateful handles — no jax in the loop, so every scheduling decision is
+deterministic): per-session accumulation and resets, scratch-slot isolation
+of mixed stateless/sessionless traffic, LRU eviction determinism + the
+journaled ``session_evict``, per-session FIFO via the batcher group key,
+load-shed 503s with a Retry-After advisory, the request-log ->
+``OfflineDataset`` round trip, and the registry's /metrics rendering."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serving.batcher import DynamicBatcher, ServeError, _Request
+from sheeprl_tpu.serving.registry import ModelEntry, ModelRegistry, render_registry_metrics
+from sheeprl_tpu.serving.request_log import RequestLog
+from sheeprl_tpu.serving.server import PolicyService
+from sheeprl_tpu.serving.sessions import SessionStore
+
+OBS = {"state": [1.0, 2.0, 3.0, 4.0]}
+
+
+def _service(handle, journal=None, capacity=4, **over) -> PolicyService:
+    cfg = {
+        "batch_buckets": [2, 4],
+        "max_delay_ms": 1.0,
+        "greedy": True,
+        "sessions": {"capacity": capacity},
+        **over,
+    }
+    return PolicyService(handle, cfg, journal=journal, aot=False).start()
+
+
+def _count(result) -> float:
+    """The fake stateful handle's action is [params, steps_since_reset, sum]."""
+    return float(np.asarray(result["action"])[1])
+
+
+# ---------------------------------------------------------------------------
+# session semantics
+# ---------------------------------------------------------------------------
+
+
+def test_session_accumulates_resets_and_isolates_sessionless(fake_stateful_handle):
+    svc = _service(fake_stateful_handle)
+    try:
+        assert _count(svc.act(OBS, session="a")) == 1.0
+        assert _count(svc.act(OBS, session="a")) == 2.0
+        assert _count(svc.act(OBS, session="a")) == 3.0
+        # "reset": true starts a new episode in the SAME slot
+        assert _count(svc.act(OBS, session="a", reset=True)) == 1.0
+        # sessionless rows ride the scratch slot with is_first forced: they
+        # are always step 1 and never disturb a resident session
+        for _ in range(3):
+            assert _count(svc.act(OBS)) == 1.0
+        assert _count(svc.act(OBS, session="a")) == 2.0
+        assert svc.sessions.active == 1
+    finally:
+        svc.close()
+
+
+def test_stateless_handle_rejects_session_field(fake_handle):
+    svc = PolicyService(fake_handle, {"batch_buckets": [2]}, aot=False).start()
+    try:
+        with pytest.raises(ServeError) as excinfo:
+            svc.act(OBS, session="nope")
+        assert excinfo.value.status == 400
+        assert "statelessly" in str(excinfo.value)
+    finally:
+        svc.close()
+
+
+def test_mixed_stateless_stateful_rows_share_dispatch_without_contamination(
+    fake_stateful_handle,
+):
+    """One session row + two sessionless rows submitted together amortize
+    into ONE dispatch, and the scratch rows still act like fresh episodes."""
+    svc = _service(fake_stateful_handle, max_delay_ms=150.0)
+    try:
+        for round_no in (1, 2, 3):
+            barrier = threading.Barrier(3)
+            results = {}
+
+            def client(tag, session):
+                barrier.wait()
+                results[tag] = svc.act(OBS, session=session)
+
+            threads = [
+                threading.Thread(target=client, args=("s", "sess")),
+                threading.Thread(target=client, args=("one", None)),
+                threading.Thread(target=client, args=("two", None)),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert {r["dispatch_id"] for r in results.values()} == {
+                results["s"]["dispatch_id"]
+            }, "the three clients were not amortized into one dispatch"
+            assert _count(results["s"]) == float(round_no)
+            assert _count(results["one"]) == 1.0
+            assert _count(results["two"]) == 1.0
+    finally:
+        svc.close()
+
+
+def test_lru_eviction_is_deterministic_and_journaled(fake_stateful_handle, journal_stub):
+    svc = _service(fake_stateful_handle, journal=journal_stub, capacity=2)
+    try:
+        assert _count(svc.act(OBS, session="a")) == 1.0  # slot 0
+        assert _count(svc.act(OBS, session="b")) == 1.0  # slot 1
+        assert _count(svc.act(OBS, session="a")) == 2.0  # LRU order: b, a
+        # "c" evicts the LRU ("b"); allocation reuses its slot
+        assert _count(svc.act(OBS, session="c")) == 1.0
+        assert svc.sessions.sessions() == ["a", "c"]
+        # an evicted session that returns is a NEW session: fresh slot,
+        # re-initialized state (count restarts), evicting the next LRU ("a")
+        assert _count(svc.act(OBS, session="b")) == 1.0
+        assert svc.sessions.sessions() == ["c", "b"]
+        evicts = [e for e in journal_stub.events if e["event"] == "session_evict"]
+        assert [e["session"] for e in evicts] == ["b", "a"]
+        assert all(e["capacity"] == 2 and e["resident"] == 1 for e in evicts)
+        assert svc.sessions.created_total == 4 and svc.sessions.evictions_total == 2
+        # an explicit drop frees the slot with no eviction journal
+        assert svc.drop_session("c") is True and svc.drop_session("c") is False
+        assert _count(svc.act(OBS, session="d")) == 1.0
+        assert svc.sessions.evictions_total == 2
+        snap = svc.snapshot()
+        assert snap["counters"]["sessions_evictions_total"] == 2
+        assert snap["gauges"]["Telemetry/sessions/capacity"] == 2
+    finally:
+        svc.close()
+
+
+def test_same_session_rows_never_share_a_dispatch(fake_stateful_handle):
+    """Two concurrent requests for ONE session must run in two ordered
+    dispatches (the batcher group key): state is gathered at most once per
+    batch, so per-session FIFO stays exact."""
+    svc = _service(fake_stateful_handle, max_delay_ms=150.0)
+    try:
+        barrier = threading.Barrier(2)
+        results = []
+
+        def client():
+            barrier.wait()
+            results.append(svc.act(OBS, session="solo"))
+
+        threads = [threading.Thread(target=client) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results[0]["dispatch_id"] != results[1]["dispatch_id"]
+        assert sorted(_count(r) for r in results) == [1.0, 2.0]
+    finally:
+        svc.close()
+
+
+def test_batch_pinned_slab_overflows_to_scratch():
+    """When one batch pins every slot, an extra session rides scratch (fresh
+    episode each time) instead of evicting a slot mid-gather."""
+    store = SessionStore({"count": ((1,), "float32")}, capacity=1, device=False)
+    idx, is_first, evicted = store.checkout(["x", "y"], [False, False], 4)
+    assert idx.tolist() == [0, store.scratch, store.scratch, store.scratch]
+    assert is_first.ravel().tolist() == [1.0, 1.0, 1.0, 1.0]
+    assert store.overflow_total == 1 and not evicted
+    # on a later dispatch with a free gather, "y" allocates normally
+    idx2, _, evicted2 = store.checkout(["y"], [False], 2)
+    assert idx2[0] == 0 and [e["session"] for e in evicted2] == ["x"]
+
+
+# ---------------------------------------------------------------------------
+# load shedding
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_sheds_with_retry_after():
+    batcher = DynamicBatcher(
+        lambda rows, greedy: (np.zeros((len(rows), 1)), {}), buckets=[4], max_queue=2
+    )
+    # not started: fill the queue directly, then submit over the limit
+    batcher._queue.append(_Request({}, True, 0.0))
+    batcher._queue.append(_Request({}, True, 0.0))
+    with pytest.raises(ServeError) as excinfo:
+        batcher.submit({}, True)
+    err = excinfo.value
+    assert err.status == 503
+    assert isinstance(err.retry_after, int) and err.retry_after >= 1
+    stats = batcher.stats()
+    assert stats["shed_total"] == 1 and stats["errors_total"] == 1
+    # the advisory scales with backlog / observed service rate, clamped 1..60
+    batcher._done_t.extend([0.0, 1.0])  # 1 response/s observed
+    for _ in range(18):
+        batcher._queue.append(_Request({}, True, 0.0))
+    with pytest.raises(ServeError) as excinfo:
+        batcher.submit({}, True)
+    assert excinfo.value.retry_after == 20
+    batcher._done_t.clear()
+    batcher._done_t.extend([0.0, 0.001])  # absurd rate: floor at 1s
+    with pytest.raises(ServeError) as excinfo:
+        batcher.submit({}, True)
+    assert excinfo.value.retry_after == 1
+
+
+# ---------------------------------------------------------------------------
+# request logging -> offline dataset round trip
+# ---------------------------------------------------------------------------
+
+
+def test_request_log_rounds_trip_through_offline_dataset(
+    tmp_path, fake_stateful_handle, journal_stub
+):
+    from sheeprl_tpu.data.datasets import OfflineDataset
+
+    root = tmp_path / "requests" / "default"
+    svc = _service(fake_stateful_handle, journal=journal_stub)
+    svc.request_log = RequestLog(
+        str(root),
+        fake_stateful_handle,
+        model="default",
+        rotate_rows=4,
+        journal=journal_stub,
+    )
+    try:
+        for step in range(3):
+            for sid in ("a", "b"):
+                svc.act({"state": [float(step)] * 4}, session=sid)
+    finally:
+        svc.close()  # flushes + drains the writer thread
+
+    rotates = [e for e in journal_stub.events if e["event"] == "request_log_rotate"]
+    assert len(rotates) == 2  # one full 4-row shard + the 2-row close flush
+    assert rotates[0]["rows"] == 4 and rotates[0]["model"] == "default"
+    assert rotates[0]["path"].startswith("shard-")
+    assert rotates[1]["shards"] == 2
+
+    ds = OfflineDataset(str(root))
+    assert ds.total_rows == 6
+    assert {"state", "actions", "rewards", "terminated", "is_first"} <= set(ds.key_specs)
+    batch = next(iter(ds.batches(6, seed=0)))
+    # each session logged is_first=1 exactly once (its first dispatch)
+    assert float(batch["is_first"].sum()) == 2.0
+    assert batch["actions"].shape == (6, 3)
+    assert float(np.abs(batch["rewards"]).sum()) == 0.0
+    # action-space metadata was recorded at collect time
+    meta = ds.meta["meta"]
+    assert meta["algo"] == "fake_recurrent" and meta["model"] == "default"
+    assert meta["actions_dim"] == [3] and meta["is_continuous"] is False
+
+
+def test_request_log_sheds_blocks_when_writer_queue_is_full(
+    tmp_path, fake_stateful_handle, journal_stub
+):
+    log = RequestLog(
+        str(tmp_path / "log"),
+        fake_stateful_handle,
+        model="m",
+        rotate_rows=1,
+        journal=journal_stub,
+    )
+    # stop the writer FIRST, then jam its bounded queue: the next rotation
+    # must shed the block (journaled dropped=true) instead of stalling
+    log._stop.set()
+    log._thread.join(timeout=5)
+    log._queue.maxsize = 1
+    log._queue.put_nowait([{"state": np.zeros(4, np.float32)}])
+    log.append([{"state": np.zeros(4, np.float32)}], np.zeros((1, 3)))
+    assert log.dropped_total == 1
+    dropped = [
+        e
+        for e in journal_stub.events
+        if e["event"] == "request_log_rotate" and e.get("dropped")
+    ]
+    assert dropped and dropped[0]["model"] == "m"
+
+
+# ---------------------------------------------------------------------------
+# registry + /metrics rendering
+# ---------------------------------------------------------------------------
+
+
+def test_registry_routes_and_404s(fake_handle, fake_stateful_handle):
+    registry = ModelRegistry()
+    registry.add(ModelEntry(name="default", service=None, handle=fake_handle), default=True)
+    registry.add(ModelEntry(name="canary", service=None, handle=fake_stateful_handle))
+    assert registry.names() == ["canary", "default"]
+    assert registry.get(None).name == "default"
+    assert registry.get("canary").handle.stateful is True
+    with pytest.raises(ServeError) as excinfo:
+        registry.get("nope")
+    assert excinfo.value.status == 404
+    assert "canary" in str(excinfo.value) and "default" in str(excinfo.value)
+    with pytest.raises(ValueError, match="already registered"):
+        registry.add(ModelEntry(name="canary", service=None, handle=fake_handle))
+
+
+def test_registry_metrics_render_per_model_then_aggregate(
+    fake_handle, fake_stateful_handle
+):
+    stateless = PolicyService(
+        fake_handle, {"batch_buckets": [2]}, aot=False, model="default"
+    ).start()
+    stateful = _service(fake_stateful_handle, capacity=3, model="canary")
+    registry = ModelRegistry()
+    registry.add(
+        ModelEntry(name="default", service=stateless, handle=fake_handle), default=True
+    )
+    registry.add(ModelEntry(name="canary", service=stateful, handle=fake_stateful_handle))
+    try:
+        stateless.act(OBS)
+        stateless.act(OBS)
+        stateful.act(OBS, session="s")
+        text = render_registry_metrics(registry)
+    finally:
+        stateless.close()
+        stateful.close()
+
+    assert "sheeprl_serve_models 2" in text
+    # one TYPE line per family (a second one is a Prometheus parse error)
+    assert text.count("# TYPE sheeprl_serve_requests_total counter") == 1
+    assert text.count("# TYPE sheeprl_sessions_active gauge") == 1
+    # per-model series first, unlabeled aggregate LAST (last-wins parsers
+    # must read the fleet total); counters sum across models
+    lines = text.splitlines()
+    labeled_default = lines.index('sheeprl_serve_requests_total{model="default"} 2')
+    labeled_canary = lines.index('sheeprl_serve_requests_total{model="canary"} 1')
+    aggregate = lines.index("sheeprl_serve_requests_total 3")
+    assert max(labeled_default, labeled_canary) < aggregate
+    # session families only carry the stateful model's label, aggregate = sum
+    assert 'sheeprl_sessions_capacity{model="canary"} 3' in text
+    assert 'sheeprl_sessions_capacity{model="default"}' not in text
+    assert "\nsheeprl_sessions_capacity 3" in text
+    # the width histogram keeps its single-model exact-substring contract
+    assert 'sheeprl_serve_batch_width_total{model="default",width="2"}' in text
+    assert 'sheeprl_serve_batch_width_total{width="2"} 3' in text
+    # run_info advertises the resident set
+    assert 'models="canary,default"' in text
+
+
+def test_sessions_full_banner_thresholds():
+    from sheeprl_tpu.diagnostics.report import sessions_full_banner
+
+    assert sessions_full_banner(1.0, 2.0) is None
+    assert sessions_full_banner(None, 2.0) is None
+    assert sessions_full_banner(0.0, 0.0) is None
+    banner = sessions_full_banner(2.0, 2.0)
+    assert banner is not None and banner.startswith("!! SESSIONS-FULL")
+    assert "serving.sessions.capacity" in banner
+
+
+def test_journal_report_serving_panel_renders_sessions_and_reqlog():
+    from sheeprl_tpu.diagnostics.report import serving_status_lines
+
+    events = [
+        {"event": "serve_start", "t": 0.0, "ckpt_step": 16, "models": ["default"]},
+        {"event": "ckpt_promote", "t": 1.0, "step": 32, "model": "default"},
+        {"event": "session_evict", "t": 2.0, "session": "a", "slot": 0, "model": "default"},
+        {
+            "event": "request_log_rotate",
+            "t": 3.0,
+            "model": "default",
+            "rows": 4,
+            "bytes": 100,
+            "shards": 1,
+        },
+        {
+            "event": "metrics",
+            "t": 4.0,
+            "step": 9,
+            "metrics": {
+                "Telemetry/sessions/active": 2.0,
+                "Telemetry/sessions/capacity": 2.0,
+            },
+        },
+    ]
+    lines = serving_status_lines(events, live=True)
+    text = "\n".join(lines)
+    assert "default@32" in text and "1 promotes" in text
+    assert "2/2 active" in text and "1 evictions" in text
+    assert "1 shards" in text and "4 rows logged" in text
+    assert any(line.startswith("!! SESSIONS-FULL") for line in lines)
+    # a finished run renders the summary without the live banner
+    done = serving_status_lines(events + [{"event": "run_end", "t": 5.0}], live=False)
+    assert not any(line.startswith("!! SESSIONS-FULL") for line in done)
+    # and a training journal (no serve_start) renders nothing
+    assert serving_status_lines([{"event": "run_start", "t": 0.0}]) == []

@@ -55,6 +55,7 @@ from sheeprl_tpu.diagnostics.report import (  # noqa: E402
     format_bytes,
     format_event_line,
     no_recent_ckpt_banner,
+    sessions_full_banner,
     stale_params_banner,
     status_block,
 )
@@ -179,13 +180,69 @@ def endpoint_status(url: str) -> str:
                 serve_parts.append(f"{label} {fmt.format(value)}")
         if serve_parts:
             lines.append("serving " + "  ".join(serve_parts))
+
+        # per-model breakdown when the registry holds several residents: the
+        # serve/sessions families carry {model="..."} series next to the
+        # unlabeled aggregates the lines above read
+        def _model_value(name: str, model: str) -> Optional[float]:
+            for labels, value in metrics["_labels"].get(name) or []:
+                if labels.get("model") == model and len(labels) == 1:
+                    return value
+            return None
+
+        model_steps = {
+            labels["model"]: value
+            for labels, value in metrics["_labels"].get("sheeprl_serve_ckpt_step") or []
+            if labels.get("model") and len(labels) == 1
+        }
+        if len(model_steps) >= 2:
+            for model in sorted(model_steps):
+                row = [f"ckpt-step {model_steps[model]:g}"]
+                requests = _model_value("sheeprl_serve_requests_total", model)
+                if requests is not None:
+                    row.append(f"{requests:g} requests")
+                active = _model_value("sheeprl_sessions_active", model)
+                if active is not None:
+                    capacity = _model_value("sheeprl_sessions_capacity", model)
+                    row.append(
+                        f"sessions {active:g}"
+                        + (f"/{capacity:g}" if capacity is not None else "")
+                    )
+                    evictions = _model_value("sheeprl_sessions_evictions_total", model)
+                    if evictions:
+                        row.append(f"{evictions:g} evicted")
+                if _model_value("sheeprl_serve_last_promote_rejected", model):
+                    row.append("REJECTED-CKPT")
+                lines.append(f"model   {model}: " + " · ".join(row))
+        sessions_active = metrics.get("sheeprl_sessions_active")
+        if sessions_active is not None:
+            sessions_capacity = metrics.get("sheeprl_sessions_capacity")
+            session_parts = [
+                f"{sessions_active:g}"
+                + (f"/{sessions_capacity:g}" if sessions_capacity is not None else "")
+                + " active"
+            ]
+            for key, label in (
+                ("sheeprl_sessions_created_total", "created"),
+                ("sheeprl_sessions_evictions_total", "evictions"),
+                ("sheeprl_sessions_overflow_total", "overflow"),
+            ):
+                value = metrics.get(key)
+                if value is not None:
+                    session_parts.append(f"{value:g} {label}")
+            lines.append("session " + " · ".join(session_parts))
+            banner = sessions_full_banner(sessions_active, sessions_capacity)
+            if banner is not None:
+                lines.append(banner)
         serve_counters = []
         for key, label in (
             ("sheeprl_serve_requests_total", "requests"),
             ("sheeprl_serve_dispatches_total", "dispatches"),
             ("sheeprl_serve_request_errors_total", "errors"),
+            ("sheeprl_serve_shed_total", "shed"),
             ("sheeprl_serve_ckpt_promotions_total", "promotions"),
             ("sheeprl_serve_ckpt_rejections_total", "rejections"),
+            ("sheeprl_serve_request_log_rows_total", "rows logged"),
         ):
             value = metrics.get(key)
             if value is not None:
