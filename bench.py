@@ -82,7 +82,7 @@ def _chip_peak(device_kind: str, precision: str):
     return peaks["bf16"] if "bf16" in precision or "16" in precision else peaks["f32"], False
 
 
-def _build(cfg_overrides, actions_dim=(6,)):
+def _build(cfg_overrides, actions_dim=(6,), mesh=None):
     import gymnasium as gym
     import numpy as np
     import optax
@@ -114,7 +114,7 @@ def _build(cfg_overrides, actions_dim=(6,)):
     opt_states = {k: optimizers[k].init(params[k]) for k in optimizers}
     moments_state = init_moments_state()
     train_step = make_train_step(
-        world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, False
+        world_model_def, actor_def, critic_def, optimizers, cfg, actions_dim, False, mesh=mesh
     )
     return cfg, world_model_def, actor_def, critic_def, params, opt_states, moments_state, train_step
 
@@ -125,12 +125,15 @@ def build_train_step_and_batch(
     batch_size: int = 16,
     sequence_length: int = 64,
     extra_overrides=(),
+    mesh=None,
 ):
     """One compiled-workload recipe, shared by ``measure_compute`` and
     ``tools/perf_study.py``'s lever study so the two can never drift: the
     flagship DV3 pixel config + a synthetic batch derived from the composed
-    config's obs keys.  Returns ``(cfg, train_step, state, batch)`` with
-    ``state = {params, opt_states, moments_state}``."""
+    config's obs keys.  ``mesh`` builds the distributed step (DP shard_map or
+    FSDP global-view jit — state/batch placement is the caller's job).
+    Returns ``(cfg, train_step, state, batch)`` with ``state = {params,
+    opt_states, moments_state}``."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -150,7 +153,8 @@ def build_train_step_and_batch(
             "metric.log_level=0",
             f"fabric.precision={precision}",
             *extra_overrides,
-        ]
+        ],
+        mesh=mesh,
     )
     T, B = cfg.algo.per_rank_sequence_length, cfg.algo.per_rank_batch_size
     rng = np.random.default_rng(0)
@@ -1273,6 +1277,137 @@ def measure_decoupled(iters: int = 8, timeout_s: float = 420.0):
     return out
 
 
+_FSDP_CHILD_SRC = r"""
+import json, sys, time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+size, precision = sys.argv[1], sys.argv[2]
+batch_size, seq_len, iters = int(sys.argv[3]), int(sys.argv[4]), int(sys.argv[5])
+
+from bench import build_train_step_and_batch
+from sheeprl_tpu.parallel.dp import stage
+from sheeprl_tpu.parallel.fsdp import shard_tree, tree_bytes_per_device
+from sheeprl_tpu.parallel.mesh import make_mesh, replicated_sharding
+
+def tree_bytes(t):
+    return sum(int(np.prod(x.shape)) * x.dtype.itemsize for x in jax.tree_util.tree_leaves(t))
+
+MIN_SHARD = 1024
+out = {}
+meshes = {
+    "dp": make_mesh(n_devices=8, axis_names=("data",)),
+    "fsdp": make_mesh(n_devices=8, axis_names=("data", "model"), axis_sizes=(1, 8)),
+}
+for name, mesh in meshes.items():
+    cfg, step, state, batch = build_train_step_and_batch(
+        precision, size=size, batch_size=batch_size, sequence_length=seq_len,
+        extra_overrides=["distribution.fsdp_min_shard_bytes=%d" % MIN_SHARD], mesh=mesh,
+    )
+    params, opt_states, moments = state["params"], state["opt_states"], state["moments_state"]
+    if name == "fsdp":
+        params = shard_tree(params, mesh, MIN_SHARD)
+        opt_states = shard_tree(opt_states, mesh, MIN_SHARD)
+    else:
+        params = jax.device_put(params, replicated_sharding(mesh))
+        opt_states = jax.device_put(opt_states, replicated_sharding(mesh))
+    moments = jax.device_put(moments, replicated_sharding(mesh))
+    batch = stage({k: np.asarray(v) for k, v in batch.items()}, mesh, batch_axis=1)
+    key = jax.random.PRNGKey(0)
+    tau = jnp.float32(0.02)
+    for _ in range(2):
+        key, sub = jax.random.split(key)
+        params, opt_states, moments, metrics = step(params, opt_states, moments, batch, sub, tau)[:4]
+    np.asarray(metrics)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        key, sub = jax.random.split(key)
+        params, opt_states, moments, metrics = step(params, opt_states, moments, batch, sub, tau)[:4]
+    final = np.asarray(metrics)
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(final).all(), name
+    out[name] = {
+        "step_ms": round(elapsed / iters * 1e3, 2),
+        "params_bytes": tree_bytes(params),
+        "params_bytes_per_device": tree_bytes_per_device(params),
+        "opt_bytes_per_device": tree_bytes_per_device(opt_states),
+    }
+print("BENCH_FSDP_JSON " + json.dumps(out), flush=True)
+"""
+
+
+def measure_fsdp(
+    precision: str,
+    size: str = "XS",
+    batch_size: int = 8,
+    sequence_length: int = 8,
+    iters: int = 4,
+    timeout_s: float = 420.0,
+):
+    """FSDP-vs-DP pair (ISSUE 17), always-lands: the SAME DV3 train step on
+    the virtual 8-device CPU mesh twice — replicated state over a 1-D
+    ``("data",)`` mesh (shard_map DP) vs partition-rule-sharded state over a
+    2-D ``(1, 8)`` ``("data", "model")`` mesh (global-view FSDP jit) — same
+    global batch, so the pair isolates exactly what sharding the train state
+    costs in step time and buys in per-device bytes.
+
+    One subprocess runs both variants (``subprocess_cli_env`` forces the
+    8-device virtual platform regardless of the parent's backend), so the
+    block lands identically on chip rounds and dead-tunnel rounds.  CPU
+    liveness numbers — ``params_per_device_shrink`` is the memory signal and
+    ``fsdp_vs_dp_step_ratio`` the serialization canary, not the absolute ms.
+    """
+    import re
+    import subprocess
+    import sys
+
+    from sheeprl_tpu.utils.utils import subprocess_cli_env
+
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            _FSDP_CHILD_SRC,
+            size,
+            precision,
+            str(batch_size),
+            str(sequence_length),
+            str(iters),
+        ],
+        env=subprocess_cli_env(device_count=8),
+        timeout=timeout_s,
+        capture_output=True,
+        text=True,
+    )
+    out: dict = {
+        "workload": (
+            f"dreamer_v3_{size} pixels, batch {batch_size} x seq {sequence_length}, "
+            f"{iters} iters on the virtual 8-device CPU mesh: replicated DP@8 vs "
+            "FSDP (1x8 model axis, min_shard_bytes=1024)"
+        ),
+        "rc": proc.returncode,
+    }
+    m = re.search(r"^BENCH_FSDP_JSON (.*)$", proc.stdout, re.MULTILINE)
+    if proc.returncode != 0 or m is None:
+        # a crashed child publishes NO timing (the measure_decoupled lesson);
+        # the stderr tail makes the failure diagnosable from the JSON line
+        out["error"] = (proc.stderr or proc.stdout or "")[-400:]
+        return out
+    out.update(json.loads(m.group(1)))
+    dp, fsdp = out.get("dp") or {}, out.get("fsdp") or {}
+    if dp.get("step_ms") and fsdp.get("step_ms"):
+        # > 1.0 = sharding costs step time (gather/scatter on the critical path)
+        out["fsdp_vs_dp_step_ratio"] = round(fsdp["step_ms"] / dp["step_ms"], 3)
+    if dp.get("params_bytes_per_device") and fsdp.get("params_bytes_per_device"):
+        # ~axis_size = the ZeRO-3 memory win; < axis_size means replicated
+        # small leaves (below min_shard_bytes or with no divisible dim)
+        out["params_per_device_shrink"] = round(
+            dp["params_bytes_per_device"] / fsdp["params_bytes_per_device"], 2
+        )
+    return out
+
+
 def measure_serving(
     loads=(1, 4, 16),
     duration_s: float = 3.0,
@@ -1657,6 +1792,13 @@ def _run_cpu_fallback(record: dict, precision: str) -> None:
         record["offline"] = measure_offline()
     except Exception as err:  # noqa: BLE001
         record.setdefault("stage_errors", {})["offline"] = repr(err)
+    # FSDP-vs-DP pair (ISSUE 17): per-device param/opt bytes and step-time
+    # ratio on the virtual 8-device mesh — a CPU subprocess by design, lands
+    # on the fallback path at the XS vector-free pixel shapes
+    try:
+        record["fsdp"] = measure_fsdp(precision, size="XS", batch_size=8, sequence_length=8, iters=4)
+    except Exception as err:  # noqa: BLE001
+        record.setdefault("stage_errors", {})["fsdp"] = repr(err)
 
 
 def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
@@ -1799,6 +1941,21 @@ def _run_chip_menu(record: dict, precision: str, deadline: float) -> None:
     if offline:
         record["offline"] = offline
 
+    # FSDP-vs-DP pair (ISSUE 17): the sharded-train-state memory win and its
+    # step-time cost on the virtual 8-device CPU mesh — a subprocess by
+    # design, so chip rounds carry the same canary; XL shapes (where the
+    # per-device bytes actually matter), short sequences to keep the CPU
+    # child inside its timeout
+    fsdp = stage(
+        "fsdp",
+        500,
+        lambda: measure_fsdp(
+            precision, size="XL", batch_size=8, sequence_length=8, iters=3, timeout_s=420.0
+        ),
+    )
+    if fsdp:
+        record["fsdp"] = fsdp
+
 
 def main() -> None:
     precision = os.environ.get("BENCH_PRECISION", "bf16-mixed")
@@ -1851,6 +2008,11 @@ def main() -> None:
         # dataset_read_sps from its own journal (measure_offline).  Null when
         # the stage was skipped or failed.
         "offline": None,
+        # FSDP sharding (ISSUE 17): DP-vs-FSDP DV3 step pair on the virtual
+        # 8-device mesh — per-device param/opt bytes under the partition rule
+        # (params_per_device_shrink ~ the ZeRO-3 win) and the step-time ratio
+        # (measure_fsdp).  Null when the stage was skipped or failed.
+        "fsdp": None,
         # CPU-fallback regression floor (VERDICT item 5): value vs the pinned
         # conservative CPU floor, with a contention-variance caveat.  Null on
         # chip rounds (the fallback path fills it).

@@ -375,12 +375,15 @@ def make_train_step(
             )
         return params, opt_states, moments_state, metrics, hstats
 
+    from sheeprl_tpu.parallel.dp import fsdp_min_shard_bytes
+
     return dp_jit(
         train_step,
         mesh,
         in_specs=(P(), P(), P(), batch_spec(batch_axis=1), P(), P()),
         out_specs=(P(), P(), P(), P(), P()),
         donate_argnums=(0, 1, 2),
+        min_shard_bytes=fsdp_min_shard_bytes(cfg),
     )
 
 
@@ -558,12 +561,29 @@ def _dreamer_main(
     if metric_order is None:
         metric_order = METRIC_ORDER
 
+    from sheeprl_tpu.parallel.dp import fsdp_min_shard_bytes
+    from sheeprl_tpu.parallel.fsdp import fsdp_active, shard_map_summary, shard_tree
     from sheeprl_tpu.parallel.mesh import replicated_sharding
 
     if world_size > 1:
-        params = jax.device_put(params, replicated_sharding(runtime.mesh))
-        opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
-        moments_state = jax.device_put(moments_state, replicated_sharding(runtime.mesh))
+        if fsdp_active(runtime.mesh):
+            # FSDP placement (howto/sharding.md): large leaves land sliced
+            # over the "model" axis, small leaves replicated — the committed
+            # shardings are what the global-view jit propagates from.  The
+            # Moments state is a handful of scalars: always replicated.
+            min_bytes = fsdp_min_shard_bytes(cfg)
+            params = shard_tree(params, runtime.mesh, min_bytes)
+            opt_states = shard_tree(opt_states, runtime.mesh, min_bytes)
+            moments_state = jax.device_put(moments_state, replicated_sharding(runtime.mesh))
+            diag.on_fsdp_shard_map(
+                shard_map_summary(
+                    {"params": params, "opt_state": opt_states}, runtime.mesh, min_bytes
+                )
+            )
+        else:
+            params = jax.device_put(params, replicated_sharding(runtime.mesh))
+            opt_states = jax.device_put(opt_states, replicated_sharding(runtime.mesh))
+            moments_state = jax.device_put(moments_state, replicated_sharding(runtime.mesh))
 
     # telemetry instrumentation (shared engine: dv3 / jepa / p2e inherit):
     # recompile watchdog + exact compiled-step FLOPs for the live MFU gauge.

@@ -342,12 +342,15 @@ def make_train_step(
         metrics = pmean_tree(metrics, axis)
         return params, opt_states, moments_state, metrics
 
+    from sheeprl_tpu.parallel.dp import fsdp_min_shard_bytes
+
     return dp_jit(
         train_step,
         mesh,
         in_specs=(P(), P(), P(), batch_spec(batch_axis=1), P(), P()),
         out_specs=(P(), P(), P(), P()),
         donate_argnums=(0, 1, 2),
+        min_shard_bytes=fsdp_min_shard_bytes(cfg),
     )
 
 

@@ -276,6 +276,39 @@ def check_configs(cfg: dotdict) -> None:
                     f"algo.rssm_chunk_burn_in ({burn_in}) must be < the chunk length "
                     f"({seq_len // rssm_chunks} = per_rank_sequence_length / rssm_chunks)"
                 )
+    # FSDP knobs (howto/sharding.md): fail at compose time — a bad axis size
+    # would otherwise surface as an opaque mesh-reshape error inside Runtime
+    fsdp_raw = cfg.fabric.get("fsdp", 1)
+    fsdp = 1 if fsdp_raw is None else int(fsdp_raw)
+    if fsdp < 1:
+        raise ValueError(f"distribution.fsdp_axis_size must be >= 1, got {fsdp}")
+    min_shard = cfg.fabric.get("fsdp_min_shard_bytes")
+    if min_shard is not None and int(min_shard) < 0:
+        raise ValueError(
+            f"distribution.fsdp_min_shard_bytes must be >= 0, got {min_shard!r}"
+        )
+    if fsdp > 1:
+        # literal set (mirrors the offline gate below): the global-view FSDP
+        # step is wired through _dreamer_main only
+        fsdp_supported = ("dreamer_v3", "dreamer_v3_jepa", "p2e_dv1", "p2e_dv2", "p2e_dv3")
+        if algo_name not in fsdp_supported:
+            raise ValueError(
+                f"distribution.fsdp_axis_size > 1 supports the DV3 family "
+                f"{list(fsdp_supported)}, got algo.name={algo_name!r}"
+            )
+        if (cfg.algo.get("offline") or {}).get("enabled"):
+            raise ValueError(
+                "distribution.fsdp_axis_size > 1 is not supported with "
+                "algo.offline.enabled=true (the offline loop is single-device)"
+            )
+        n_dev = devices
+        if isinstance(n_dev, str) and n_dev not in ("auto", "-1"):
+            n_dev = int(n_dev)
+        if isinstance(n_dev, int) and n_dev > 0 and n_dev % fsdp != 0:
+            raise ValueError(
+                f"distribution.fsdp_axis_size ({fsdp}) must divide "
+                f"fabric.devices ({n_dev})"
+            )
     # offline training mode (howto/offline_rl.md): fail at compose time, not
     # after the log dir exists — the mode swaps the whole entrypoint
     offline_cfg = cfg.algo.get("offline") or {}

@@ -552,6 +552,18 @@ class Diagnostics:
         if self.memory is not None:
             self.memory.track_buffer(name, buffer)
 
+    def on_fsdp_shard_map(self, summary: Mapping[str, Any]) -> None:
+        """Record how the FSDP partition rule laid out the train state
+        (``parallel/fsdp.py::shard_map_summary``): journals the
+        ``fsdp_shard_map`` event and arms the memory monitor's per-device
+        accounting (``Telemetry/fsdp_axis_size`` gauge + the ``min_shard_bytes``
+        exemption in the sharding audit).  No-op when disabled."""
+        if not self.enabled:
+            return
+        if self.memory is not None:
+            self.memory.note_fsdp(summary)
+        self._journal_event("fsdp_shard_map", **dict(summary))
+
     # -- journal hooks -----------------------------------------------------
     def log_metrics(self, step: Optional[int], metrics: Mapping[str, Any]) -> None:
         """Journal one aggregated-metrics interval + run divergence checks.

@@ -263,8 +263,19 @@ def _sharding_row(path: str, leaf: Any) -> Optional[Dict[str, Any]]:
     row["n_devices"] = n_devices
     row["replicated"] = replicated
     # a replicated array costs its FULL size on every device; a sharded one
-    # costs its shard
-    row["bytes_per_device"] = nbytes if replicated else max(1, nbytes) // n_devices
+    # costs its shard — shard_shape is exact for partially-replicated 2-D
+    # layouts (replicated over "data", sharded over "model")
+    per_device = nbytes if replicated else max(1, nbytes) // n_devices
+    if sharding is not None and not replicated:
+        try:
+            import numpy as np
+
+            shard_shape = sharding.shard_shape(tuple(leaf.shape))
+            itemsize = np.dtype(leaf.dtype).itemsize
+            per_device = int(np.prod(shard_shape, dtype=np.int64)) * itemsize
+        except Exception:
+            pass
+    row["bytes_per_device"] = per_device
     return row
 
 
@@ -273,13 +284,18 @@ def sharding_table(
     kwargs: Mapping[str, Any],
     top_n: int = 20,
     replicated_warn_bytes: Optional[int] = None,
+    fsdp_axis_size: Optional[int] = None,
+    fsdp_min_shard_bytes: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Per-leaf bytes/sharding rows of a dispatch's arguments, largest
     per-device cost first, plus totals (the ``sharding_audit`` payload).
 
     ``flagged_replicated`` is computed over ALL leaves before the table is
     truncated to ``top_n`` rows — a large replicated array must be flagged
-    even when many sharded leaves outrank it."""
+    even when many sharded leaves outrank it.  Under FSDP
+    (``fsdp_axis_size > 1``) leaves below ``fsdp_min_shard_bytes`` are exempt
+    — the partition rule replicates them *on purpose* — and the flag comes
+    with an actionable ``hint`` naming the knob instead of a bare list."""
     rows: List[Dict[str, Any]] = []
     for path, leaf in tree_leaf_sizes((args, dict(kwargs))):
         row = _sharding_row(path, leaf)
@@ -295,9 +311,27 @@ def sharding_table(
         "rows": rows[: max(1, int(top_n))],
     }
     if replicated_warn_bytes is not None:
+        fsdp_on = fsdp_axis_size is not None and int(fsdp_axis_size) > 1
+        exempt_below = int(fsdp_min_shard_bytes or 0) if fsdp_on else 0
         out["flagged_replicated"] = [
-            r["path"] for r in rows if r["replicated"] and r["bytes"] >= replicated_warn_bytes
+            r["path"]
+            for r in rows
+            if r["replicated"] and r["bytes"] >= max(replicated_warn_bytes, exempt_below)
         ]
+        if out["flagged_replicated"]:
+            if fsdp_on:
+                out["hint"] = (
+                    f"replicated leaves >= distribution.fsdp_min_shard_bytes under "
+                    f"fsdp_axis_size={int(fsdp_axis_size)}: no dimension is divisible by "
+                    "the model axis — consider padding the layer width or lowering the "
+                    "axis size (howto/sharding.md)"
+                )
+            else:
+                out["hint"] = (
+                    "large replicated leaves on a multi-device mesh: set "
+                    "distribution.fsdp_axis_size > 1 (fabric.fsdp) to shard them over "
+                    "a second 'model' mesh axis (howto/sharding.md)"
+                )
     return out
 
 
@@ -371,6 +405,9 @@ class MemoryMonitor:
         self._journal_fn: Optional[Callable[..., None]] = None
         self._sync_fn: Optional[Callable[[], None]] = None
         self._footprints: Dict[str, int] = {}
+        self._footprints_per_device: Dict[str, int] = {}
+        # armed by the facade's on_fsdp_shard_map: {"axis_size", "min_shard_bytes"}
+        self._fsdp: Optional[Dict[str, int]] = None
         self._buffers: Dict[str, Any] = {}
         self._executables: Dict[str, Dict[str, int]] = {}
         self._train_calls = 0
@@ -411,8 +448,32 @@ class MemoryMonitor:
         if not self.enabled:
             return
         size = int(tree_or_bytes) if isinstance(tree_or_bytes, (int, float)) else tree_bytes(tree_or_bytes)
+        per_device = None
+        if not isinstance(tree_or_bytes, (int, float)):
+            try:
+                from sheeprl_tpu.parallel.fsdp import tree_bytes_per_device
+
+                per_device = tree_bytes_per_device(tree_or_bytes)
+            except Exception:  # pragma: no cover - never block registration
+                per_device = None
         with self._lock:
             self._footprints[str(name)] = size
+            if per_device is not None and per_device != size:
+                # only genuinely sharded components get a per-device entry —
+                # replicated/host trees cost their full size everywhere
+                self._footprints_per_device[str(name)] = per_device
+
+    def note_fsdp(self, summary: Mapping[str, Any]) -> None:
+        """Arm FSDP-aware accounting (called via the facade's
+        ``on_fsdp_shard_map``): the axis-size gauge, the sharding audit's
+        ``min_shard_bytes`` exemption, and the per-device breakdown column."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._fsdp = {
+                "axis_size": int(summary.get("axis_size", 1) or 1),
+                "min_shard_bytes": int(summary.get("min_shard_bytes", 0) or 0),
+            }
 
     def track_buffer(self, name: str, buffer: Any) -> None:
         """Track a replay buffer's live footprint (re-queried every metric
@@ -604,9 +665,16 @@ class MemoryMonitor:
 
     # -- first-dispatch audits ----------------------------------------------
     def _sharding_audit(self, inst: Any, args: Tuple[Any, ...], kwargs: Mapping[str, Any]) -> None:
+        with self._lock:
+            fsdp = dict(self._fsdp) if self._fsdp else {}
         try:
             table = sharding_table(
-                args, kwargs, top_n=self.audit_top_n, replicated_warn_bytes=self.replicated_warn_bytes
+                args,
+                kwargs,
+                top_n=self.audit_top_n,
+                replicated_warn_bytes=self.replicated_warn_bytes,
+                fsdp_axis_size=fsdp.get("axis_size"),
+                fsdp_min_shard_bytes=fsdp.get("min_shard_bytes"),
             )
         except Exception:  # pragma: no cover - never block the dispatch
             return
@@ -645,6 +713,8 @@ class MemoryMonitor:
         out: Dict[str, Any] = {}
         with self._lock:
             components = dict(self._footprints)
+            per_device = dict(self._footprints_per_device)
+            fsdp = dict(self._fsdp) if self._fsdp else None
             executables = {k: dict(v) for k, v in self._executables.items()}
             buffers = dict(self._buffers)
         for name, buf in buffers.items():
@@ -652,6 +722,13 @@ class MemoryMonitor:
             for kind, size in fp.items():
                 components[f"{name}_{kind}"] = size
         out["components"] = components
+        if per_device:
+            # present only when something is genuinely sharded (FSDP runs):
+            # the per-device cost of each component, report.py renders the
+            # extra column
+            out["components_per_device"] = per_device
+        if fsdp:
+            out["fsdp_axis_size"] = fsdp["axis_size"]
         if executables:
             out["executables"] = executables
         stats = device_memory_stats()
@@ -697,6 +774,13 @@ class MemoryMonitor:
         if rss is not None:
             out["Telemetry/host_rss_bytes"] = float(rss)
         with self._lock:
+            fsdp = dict(self._fsdp) if self._fsdp else None
+            params_per_device = self._footprints_per_device.get("params")
+        if fsdp is not None:
+            out["Telemetry/fsdp_axis_size"] = float(fsdp["axis_size"])
+            if params_per_device is not None:
+                out["Telemetry/params_bytes_per_device"] = float(params_per_device)
+        with self._lock:
             buffers = dict(self._buffers)
         for name, buf in buffers.items():
             for kind, size in buffer_footprint(buf).items():
@@ -723,7 +807,8 @@ class MemoryMonitor:
         snap = self.snapshot()
         with self._lock:
             components = dict(self._footprints)
-        return {
+            per_device = dict(self._footprints_per_device)
+        out = {
             "host_transfers": snap["counters"]["host_transfers_total"],
             "donation_miss_leaves": snap["counters"]["donation_miss_leaves_total"],
             "oom_events": snap["counters"]["oom_events_total"],
@@ -731,6 +816,9 @@ class MemoryMonitor:
             "transfer_guard": self.transfer_mode,
             "components": components,
         }
+        if per_device:
+            out["components_per_device"] = per_device
+        return out
 
 
 # ---------------------------------------------------------------------------

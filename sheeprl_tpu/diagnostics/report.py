@@ -689,15 +689,28 @@ def serving_status_lines(events: List[Dict[str, Any]], live: bool = True) -> Lis
 
 def format_memory_breakdown(event: Dict[str, Any]) -> str:
     """The ``memory_breakdown`` journal event as a footprint table."""
-    lines = ["static footprint breakdown" + (f" (source: {event.get('source', '?')})" if event.get("source") else "")]
+    header = "static footprint breakdown" + (f" (source: {event.get('source', '?')})" if event.get("source") else "")
+    if event.get("fsdp_axis_size"):
+        header += f" [fsdp axis={event['fsdp_axis_size']}]"
+    lines = [header]
     components = event.get("components") or {}
+    per_device = event.get("components_per_device") or {}
     total = 0
+    total_per_device = 0
     for name, size in sorted(components.items(), key=lambda kv: -(kv[1] if isinstance(kv[1], (int, float)) else 0)):
         if not isinstance(size, (int, float)) or size <= 0:
             continue
         total += size
-        lines.append(f"  {name:<24s} {format_bytes(size):>12s}")
-    lines.append(f"  {'total (components)':<24s} {format_bytes(total):>12s}")
+        row = f"  {name:<24s} {format_bytes(size):>12s}"
+        dev = per_device.get(name)
+        total_per_device += dev if isinstance(dev, (int, float)) else size
+        if isinstance(dev, (int, float)):
+            row += f"  ({format_bytes(dev)}/device)"
+        lines.append(row)
+    total_row = f"  {'total (components)':<24s} {format_bytes(total):>12s}"
+    if per_device:
+        total_row += f"  ({format_bytes(total_per_device)}/device)"
+    lines.append(total_row)
     for fn, analysis in sorted((event.get("executables") or {}).items()):
         lines.append(f"  executable {fn}:")
         for key in ("argument_bytes", "output_bytes", "temp_bytes", "generated_code_bytes", "alias_bytes"):
@@ -741,6 +754,31 @@ def format_sharding_audit(event: Dict[str, Any]) -> str:
                 nd=row.get("n_devices", 1),
                 path=row.get("path", "?"),
                 mark=mark,
+            )
+        )
+    if event.get("hint"):
+        lines.append(f"  hint: {event['hint']}")
+    return "\n".join(lines)
+
+
+def format_fsdp_shard_map(event: Dict[str, Any]) -> str:
+    """The ``fsdp_shard_map`` journal event: how the partition rule laid out
+    each train-state tree over the ``model`` mesh axis."""
+    lines = [
+        "fsdp shard map: axis_size={axis} min_shard_bytes={floor}".format(
+            axis=event.get("axis_size", "?"), floor=event.get("min_shard_bytes", "?")
+        )
+    ]
+    for name, row in sorted((event.get("trees") or {}).items()):
+        lines.append(
+            "  {name:<12s} {sharded}/{leaves} leaves sharded ({repl} replicated) · "
+            "{total} global → {per_dev}/device".format(
+                name=name,
+                sharded=row.get("sharded", "?"),
+                leaves=row.get("leaves", "?"),
+                repl=row.get("replicated", "?"),
+                total=format_bytes(row.get("bytes")),
+                per_dev=format_bytes(row.get("bytes_per_device")),
             )
         )
     return "\n".join(lines)

@@ -49,6 +49,7 @@ EVENT_KINDS: Dict[str, str] = {
     "telemetry_summary": "closing perf totals (recompiles, compile time, FLOPs, phase seconds)",
     "memory_breakdown": "one-shot static footprint decomposition at first train dispatch",
     "sharding_audit": "per-leaf bytes/sharding table of the first train dispatch",
+    "fsdp_shard_map": "FSDP partition-rule layout of the train state: axis size, min_shard_bytes, per-tree sharded/replicated leaf counts and global vs per-device bytes",
     "donation_miss": "declared donations whose buffers were still alive after dispatch",
     "host_transfer": "a transfer-guard trip (device<->host sync) with provenance",
     "oom": "RESOURCE_EXHAUSTED forensics: full memory snapshot, fsync'd before re-raise",
@@ -162,6 +163,8 @@ METRICS: Dict[str, str] = {
     "sheeprl_health_value_ev": "latest value-function explained variance (ppo/a2c)",
     "sheeprl_health_anomalies": "learning-health anomalies currently active",
     # memory gauges (Telemetry/hbm_* etc., prefix-stripped)
+    "sheeprl_fsdp_axis_size": "extent of the FSDP ('model') mesh axis this run shards params over (absent on pure-DP runs)",
+    "sheeprl_params_bytes_per_device": "param bytes one device holds under the FSDP partition rule (vs the replicated global size)",
     "sheeprl_hbm_bytes_in_use": "per-device HBM bytes in use (max over devices)",
     "sheeprl_hbm_peak_bytes": "per-device HBM peak bytes (max over devices)",
     "sheeprl_hbm_largest_alloc_bytes": "largest single HBM allocation",

@@ -21,6 +21,15 @@ Off-policy loops use these helpers so a single code path serves 1..N devices:
 - :func:`dp_jit` — shard_map + jit wrapper
 - :func:`stage` — host batch → sharded device arrays (``device_put`` with a
   ``NamedSharding``; raw dtype travels over PCIe, normalization runs sharded)
+
+FSDP (2-D ``("data", "model")`` mesh — parallel/fsdp.py owns the partition
+rule): the step compiles through a *global-view* jit instead of shard_map.
+``dp_axis`` returns ``None`` on a model-axis mesh, so ``fold_key`` /
+``pmean_tree`` / ``all_gather_cat`` become identities and ``jax.grad`` yields
+global gradients; layout flows from the committed input shardings (params
+sharded by :func:`fsdp.shard_tree`, batch sharded over both axes by
+:func:`stage`) plus the output constraints :func:`dp_jit` applies — the
+all-gather/reduce-scatter pattern is inserted by XLA, not hand-written.
 """
 
 from __future__ import annotations
@@ -32,11 +41,27 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.parallel.mesh import MODEL_AXIS, model_axis_size
+
 AXIS = "data"
 
 
+def fsdp_axis(mesh: Optional[Mesh]) -> Optional[str]:
+    """The ``model`` (FSDP) axis name when the mesh has one of extent > 1."""
+    if model_axis_size(mesh) > 1:
+        return MODEL_AXIS
+    return None
+
+
 def dp_axis(mesh: Optional[Mesh]) -> Optional[str]:
-    """The data-parallel axis name if ``mesh`` spans >1 device, else None."""
+    """The data-parallel axis name if ``mesh`` spans >1 device, else None.
+
+    Deliberately ``None`` on an FSDP (model-axis) mesh: that path runs
+    global-view jit, so the explicit per-device collectives keyed off this
+    axis must become no-ops.
+    """
+    if fsdp_axis(mesh) is not None:
+        return None
     if mesh is not None and mesh.devices.size > 1:
         return AXIS
     return None
@@ -71,19 +96,52 @@ def dp_jit(
     in_specs: Sequence[Any],
     out_specs: Any,
     donate_argnums: Tuple[int, ...] = (),
+    min_shard_bytes: Optional[int] = None,
 ):
     """shard_map ``fn`` over the 1-D data mesh and jit it.
 
     ``fn`` must already be written for the local view (fold its RNG keys with
     :func:`fold_key`, pmean its grads with :func:`pmean_tree`).  When ``mesh``
     is None/size-1, this is a plain ``jax.jit`` — one code path for both.
+
+    FSDP mesh: global-view jit.  The per-device collectives inside ``fn`` are
+    already no-ops (``dp_axis`` returned None to the caller), inputs carry
+    committed shardings, and every *output* leaf is constrained to its
+    partition-rule spec (``min_shard_bytes`` tunes the rule) — params-out gets
+    the identical spec as params-in, keeping donation an in-place shard-to-
+    shard alias and the steady-state layout stable across iterations.
     """
+    if fsdp_axis(mesh) is not None:
+        from sheeprl_tpu.parallel.fsdp import constrain_tree
+
+        def constrained(*args):
+            out = fn(*args)
+            return constrain_tree(out, mesh, min_shard_bytes)
+
+        return jax.jit(constrained, donate_argnums=donate_argnums)
     if dp_axis(mesh) is None:
         return jax.jit(fn, donate_argnums=donate_argnums)
     from sheeprl_tpu.parallel.compat import shard_map
 
     mapped = shard_map(fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs, check_vma=False)
     return jax.jit(mapped, donate_argnums=donate_argnums)
+
+
+def fsdp_min_shard_bytes(cfg) -> Optional[int]:
+    """The configured FSDP replication floor, or None for the rule default.
+
+    ``fabric.fsdp_min_shard_bytes`` interpolates ``distribution.
+    fsdp_min_shard_bytes`` in the shipped configs; checking fabric first keeps
+    a direct fabric override and the train step consistent."""
+    for section in ("fabric", "distribution"):
+        try:
+            block = cfg.get(section) or {}
+            value = block.get("fsdp_min_shard_bytes")
+        except AttributeError:
+            continue
+        if value is not None:
+            return int(value)
+    return None
 
 
 def local_sample_size(global_batch: int, device_resident: bool = False) -> int:
@@ -111,10 +169,13 @@ def local_sample_size(global_batch: int, device_resident: bool = False) -> int:
     return global_batch // n
 
 
-def batch_spec(batch_axis: int = 0) -> P:
+def batch_spec(batch_axis: int = 0, mesh: Optional[Mesh] = None) -> P:
     """PartitionSpec sharding ``batch_axis`` over the data axis (prefix-spec
-    for a whole batch pytree)."""
-    return P(*([None] * batch_axis), AXIS)
+    for a whole batch pytree).  On an FSDP mesh the batch shards over *both*
+    axes — FSDP is still data parallelism (ZeRO-3: every device trains its
+    own rows, only the params/opt-state are sharded)."""
+    entry = (AXIS, MODEL_AXIS) if fsdp_axis(mesh) is not None else AXIS
+    return P(*([None] * batch_axis), entry)
 
 
 def stage(tree: Any, mesh: Optional[Mesh], batch_axis: int = 0) -> Any:
@@ -123,17 +184,19 @@ def stage(tree: Any, mesh: Optional[Mesh], batch_axis: int = 0) -> Any:
     Single-device: plain ``jnp.asarray``.  Multi-device: ``jax.device_put``
     with a ``NamedSharding`` — each device receives only its shard (this is
     what makes DP *real*: the compiled step's batch argument sharding is
-    ``P(..., "data")``, not replicated).
+    ``P(..., "data")``, not replicated).  FSDP meshes shard the batch over
+    both axes (see :func:`batch_spec`).
     """
-    if dp_axis(mesh) is None:
+    if mesh is None or mesh.devices.size <= 1:
         return jax.tree_util.tree_map(jnp.asarray, tree)
+    batch_entry = (AXIS, MODEL_AXIS) if fsdp_axis(mesh) is not None else AXIS
     sharding_cache = {}
     multiprocess = len(getattr(mesh, "devices", np.empty(0)).ravel()) > len(jax.local_devices())
 
     def put(x):
         x = np.asarray(x)
         spec = [None] * x.ndim
-        spec[batch_axis] = AXIS
+        spec[batch_axis] = batch_entry
         key = x.ndim
         if key not in sharding_cache:
             sharding_cache[key] = NamedSharding(mesh, P(*spec))

@@ -1,0 +1,192 @@
+"""FSDP partition rules over the 2-D ``("data", "model")`` mesh.
+
+ZeRO-3 / GSPMD-style sharding (Rajbhandari et al. 2020; Xu et al. 2021) for
+the DV3-family train step: parameters and optimizer state are *sharded* over
+the ``model`` axis instead of replicated, so per-device HBM scales down with
+``distribution.fsdp_axis_size`` while the compiled graph stays one jit
+program — XLA inserts the all-gather (params into the matmuls) and
+reduce-scatter (gradients back to shards) itself.
+
+The partition rule is deliberately tiny and **deterministic on
+``(shape, dtype)`` alone**:
+
+- a leaf smaller than ``min_shard_bytes`` is replicated (``P()``) — gathering
+  it would cost more latency than the bytes it frees;
+- otherwise the *largest* dimension divisible by the model-axis size is
+  sharded over ``"model"`` (ties break toward the leading axis); a leaf with
+  no divisible dimension stays replicated.
+
+Determinism matters beyond the train step: the sharded-checkpoint writer
+(resilience/sharded.py) re-applies the same rule on host arrays to decide
+which leaves to slice, and resume under a *different* ``fsdp_axis_size``
+just re-runs the rule with the new extent.
+
+Unlike the 1-D DP path (shard_map + explicit ``lax.pmean``), the FSDP path is
+global-view: ``dp_axis`` returns ``None`` on a model-axis mesh, so the
+per-device collectives in the algo bodies become no-ops and ``jax.grad``
+produces global gradients — the sharding propagates from the committed input
+shardings plus the output constraints applied by ``dp.dp_jit``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.parallel.mesh import MODEL_AXIS, model_axis_size
+
+# Below this, a leaf is cheaper to replicate than to gather: biases, layer
+# norms, scalar moments.  Overridable via ``distribution.fsdp_min_shard_bytes``.
+DEFAULT_MIN_SHARD_BYTES = 65536
+
+
+def fsdp_active(mesh: Optional[Mesh]) -> bool:
+    """True when ``mesh`` has a ``model`` axis of extent > 1."""
+    return model_axis_size(mesh) > 1
+
+
+def shard_axis(
+    shape: Tuple[int, ...],
+    dtype: Any,
+    axis_size: int,
+    min_shard_bytes: Optional[int] = None,
+) -> Optional[int]:
+    """The dimension index the rule shards over ``"model"``, or None.
+
+    Pure function of ``(shape, dtype, axis_size, min_shard_bytes)`` — the
+    train step, the memory audit, and the checkpoint writer all call this so
+    they can never disagree about a leaf's layout.
+    """
+    if min_shard_bytes is None:
+        min_shard_bytes = DEFAULT_MIN_SHARD_BYTES
+    if axis_size <= 1 or not shape:
+        return None
+    nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    if nbytes < min_shard_bytes:
+        return None
+    best = None
+    for i, dim in enumerate(shape):
+        if dim >= axis_size and dim % axis_size == 0:
+            if best is None or dim > shape[best]:
+                best = i
+    return best
+
+
+def leaf_spec(leaf: Any, axis_size: int, min_shard_bytes: Optional[int] = None) -> P:
+    """PartitionSpec for one leaf under the rule (``P()`` = replicated)."""
+    shape = tuple(np.shape(leaf))
+    try:
+        dtype = np.dtype(leaf.dtype)
+    except (AttributeError, TypeError):
+        dtype = np.asarray(leaf).dtype
+    axis = shard_axis(shape, dtype, axis_size, min_shard_bytes)
+    if axis is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[axis] = MODEL_AXIS
+    return P(*spec)
+
+
+def spec_tree(tree: Any, axis_size: int, min_shard_bytes: Optional[int] = None) -> Any:
+    """Per-leaf PartitionSpecs for a whole pytree."""
+    return jax.tree_util.tree_map(lambda x: leaf_spec(x, axis_size, min_shard_bytes), tree)
+
+
+def shard_tree(tree: Any, mesh: Mesh, min_shard_bytes: Optional[int] = None) -> Any:
+    """Commit a host/replicated pytree onto the mesh under the rule.
+
+    This is the FSDP replacement for ``mesh.replicate``: large leaves land
+    sliced over ``"model"``, small leaves land replicated.  The committed
+    shardings are what jit propagates from — no in_shardings needed.
+    """
+    axis_size = model_axis_size(mesh)
+
+    def put(x):
+        return jax.device_put(x, NamedSharding(mesh, leaf_spec(x, axis_size, min_shard_bytes)))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def constrain_tree(tree: Any, mesh: Mesh, min_shard_bytes: Optional[int] = None) -> Any:
+    """``with_sharding_constraint`` every leaf to its rule spec (traced side).
+
+    Applied by ``dp.dp_jit`` to the train step's *outputs* so the steady-state
+    layout is stable across iterations and buffer donation aliases shard to
+    shard (params-in spec == params-out spec by rule determinism).
+    """
+    axis_size = model_axis_size(mesh)
+
+    def constrain(x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, leaf_spec(x, axis_size, min_shard_bytes))
+        )
+
+    return jax.tree_util.tree_map(constrain, tree)
+
+
+def tree_bytes_per_device(tree: Any) -> int:
+    """Bytes one device holds for ``tree``, from the leaves' actual shardings.
+
+    Uses ``sharding.shard_shape`` so partially-replicated layouts (replicated
+    over ``data``, sharded over ``model``) are counted exactly; leaves without
+    a sharding (host arrays) count full size.
+    """
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = tuple(np.shape(leaf))
+        try:
+            itemsize = np.dtype(leaf.dtype).itemsize
+        except (AttributeError, TypeError):
+            itemsize = np.asarray(leaf).dtype.itemsize
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and shape:
+            try:
+                shape = tuple(sharding.shard_shape(shape))
+            except Exception:
+                pass
+        total += int(np.prod(shape, dtype=np.int64)) * itemsize if shape else itemsize
+    return total
+
+
+def shard_map_summary(
+    trees: Dict[str, Any], mesh: Mesh, min_shard_bytes: Optional[int] = None
+) -> Dict[str, Any]:
+    """JSON-safe summary of how the rule lays out the named trees — the
+    payload of the ``fsdp_shard_map`` journal event."""
+    axis_size = model_axis_size(mesh)
+    out: Dict[str, Any] = {
+        "axis_size": axis_size,
+        "min_shard_bytes": int(
+            DEFAULT_MIN_SHARD_BYTES if min_shard_bytes is None else min_shard_bytes
+        ),
+        "trees": {},
+    }
+    for name, tree in trees.items():
+        leaves = jax.tree_util.tree_leaves(tree)
+        sharded = replicated = 0
+        global_bytes = per_device = 0
+        for leaf in leaves:
+            shape = tuple(np.shape(leaf))
+            try:
+                itemsize = np.dtype(leaf.dtype).itemsize
+            except (AttributeError, TypeError):
+                itemsize = np.asarray(leaf).dtype.itemsize
+            nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize if shape else itemsize
+            global_bytes += nbytes
+            if shard_axis(shape, getattr(leaf, "dtype", np.float32), axis_size, min_shard_bytes) is None:
+                replicated += 1
+                per_device += nbytes
+            else:
+                sharded += 1
+                per_device += nbytes // axis_size
+        out["trees"][name] = {
+            "leaves": len(leaves),
+            "sharded": sharded,
+            "replicated": replicated,
+            "bytes": global_bytes,
+            "bytes_per_device": per_device,
+        }
+    return out

@@ -9,6 +9,8 @@ a `psum` riding ICI.  Multi-host extends the same mesh over DCN via
 
 Axis conventions used across the framework:
 - ``data``: data-parallel axis (batch sharded, params replicated)
+- ``model``: FSDP axis (params/opt-state sharded — parallel/fsdp.py owns the
+  partition rule; batch sharded over *both* axes so FSDP is still DP + ZeRO-3)
 - ``trainer``/player sub-meshes: decoupled topology (algos/ppo/ppo_decoupled.py)
 """
 
@@ -20,20 +22,46 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+MODEL_AXIS = "model"
+
 
 def make_mesh(
     n_devices: Optional[int] = None,
     axis_names: Sequence[str] = ("data",),
     devices: Optional[Sequence[Any]] = None,
+    axis_sizes: Optional[Sequence[int]] = None,
 ) -> Mesh:
+    """Build the device mesh.
+
+    1-D (the default): all devices on one axis.  2-D (``("data", "model")``):
+    ``axis_sizes`` gives the extent of every axis — the trailing (``model``)
+    axis rides ICI-adjacent devices so FSDP's all-gather/reduce-scatter stays
+    on the fastest links, exactly the GSPMD mesh-major convention.
+    """
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     arr = np.asarray(devices)
-    if len(axis_names) > 1:
-        raise NotImplementedError("only 1-D meshes are used in this build")
-    return Mesh(arr.reshape(-1), axis_names)
+    if len(axis_names) == 1:
+        return Mesh(arr.reshape(-1), axis_names)
+    if axis_sizes is None or len(axis_sizes) != len(axis_names):
+        raise ValueError(
+            f"a {len(axis_names)}-D mesh needs axis_sizes of the same length, got {axis_sizes!r}"
+        )
+    want = int(np.prod(axis_sizes))
+    if want != arr.size:
+        raise ValueError(
+            f"axis_sizes {tuple(axis_sizes)} needs {want} devices but the mesh has {arr.size}"
+        )
+    return Mesh(arr.reshape(tuple(axis_sizes)), tuple(axis_names))
+
+
+def model_axis_size(mesh: Optional[Mesh]) -> int:
+    """Extent of the ``model`` (FSDP) axis; 1 when the mesh is 1-D/absent."""
+    if mesh is None or MODEL_AXIS not in mesh.axis_names:
+        return 1
+    return int(mesh.shape[MODEL_AXIS])
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:

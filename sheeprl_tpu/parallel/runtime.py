@@ -48,6 +48,8 @@ class Runtime:
         accelerator: str = "auto",
         precision: str = "32-true",
         callbacks: Optional[Sequence[Any]] = None,
+        fsdp: int = 1,
+        fsdp_min_shard_bytes: Optional[int] = None,
     ):
         self.num_nodes = num_nodes
         self.strategy = strategy
@@ -81,7 +83,22 @@ class Runtime:
             n = int(devices)
         if n > len(available):
             raise ValueError(f"Requested {n} devices but only {len(available)} are available")
-        self.mesh = make_mesh(n_devices=n, axis_names=("data",))
+        self.fsdp = int(fsdp or 1)
+        self.fsdp_min_shard_bytes = None if fsdp_min_shard_bytes is None else int(fsdp_min_shard_bytes)
+        if self.fsdp > 1:
+            if n % self.fsdp != 0:
+                raise ValueError(
+                    f"fsdp axis size ({self.fsdp}) must divide the device count ({n})"
+                )
+            # 2-D ("data", "model") mesh: batch shards over both axes, params
+            # and optimizer state shard over "model" (parallel/fsdp.py rule).
+            self.mesh = make_mesh(
+                n_devices=n,
+                axis_names=("data", "model"),
+                axis_sizes=(n // self.fsdp, self.fsdp),
+            )
+        else:
+            self.mesh = make_mesh(n_devices=n, axis_names=("data",))
         self._launched = False
 
     # -- topology ---------------------------------------------------------
@@ -179,11 +196,26 @@ class Runtime:
         step → one ``ckpt_<step>_<rank>.ckpt`` shard per rank with a group
         manifest, so resume selection can reject torn snapshots.  The
         single-process path below is bit-identical to the pre-coordination
-        behavior."""
+        behavior.
+
+        FSDP (``fsdp > 1``, single process): the save is *truly sharded* —
+        one ``ckpt_<step>_<k>.ckpt`` partial per model-axis shard, each
+        holding only the leaf slices that shard owns, with the layout
+        recorded in the manifest group (resilience/sharded.py).  Bytes per
+        shard scale down with the axis; the write is synchronous (partials
+        must land as one verified group)."""
         if jax.process_count() > 1:
             from sheeprl_tpu.resilience.coordination import coordinated_save
 
             coordinated_save(self, path, state)
+            return
+        if self.fsdp > 1:
+            from sheeprl_tpu.resilience.sharded import save_sharded_checkpoint
+
+            save_sharded_checkpoint(
+                path, state, axis_size=self.fsdp, min_shard_bytes=self.fsdp_min_shard_bytes
+            )
+            self.barrier()
             return
         if self.is_global_zero:
             diagnostics = self.diagnostics
@@ -198,9 +230,20 @@ class Runtime:
         """Checkpoint read; a non-zero rank of a multi-process run loads its
         own shard of a coordinated group when one exists next to the
         (canonical, rank-0) resolved path, falling back to the rank-0 file —
-        today's state is replicated, so the fallback is always valid."""
+        today's state is replicated, so the fallback is always valid.
+
+        FSDP partial-shard groups are detected from the shard-0 manifest and
+        reassembled into the full host tree (resilience/sharded.py) — the
+        loaded tree is axis-size-agnostic, so resuming under a *different*
+        ``fsdp_axis_size`` (or pure DP) just re-places it under the new
+        rule."""
         from sheeprl_tpu.utils.checkpoint import load_state
 
+        if jax.process_count() == 1:
+            from sheeprl_tpu.resilience.sharded import is_partial_checkpoint, load_sharded_checkpoint
+
+            if is_partial_checkpoint(path):
+                return load_sharded_checkpoint(path)
         if jax.process_count() > 1 and jax.process_index() > 0:
             from sheeprl_tpu.resilience.coordination import rank_shard_path
 
@@ -230,6 +273,8 @@ def get_single_device_runtime(runtime: Runtime) -> Runtime:
     single.compute_dtype = runtime.compute_dtype
     single.callbacks = runtime.callbacks
     single.diagnostics = runtime.diagnostics
+    single.fsdp = 1
+    single.fsdp_min_shard_bytes = None
     single.mesh = make_mesh(n_devices=1, devices=[runtime.device])
     single._launched = True
     return single

@@ -1,0 +1,197 @@
+"""Truly-sharded (partial) checkpoints for FSDP runs.
+
+``resilience/coordination.py`` made the checkpoint a *group* artifact — one
+``ckpt_<step>_<rank>.ckpt`` per rank with torn-group skipping — but every
+shard still held the full replicated tree.  This module makes shards true
+partials: shard ``k`` serializes only the leaf **slices** the ``model``-axis
+shard ``k`` owns, so an XXL checkpoint's bytes scale down with
+``fsdp_axis_size`` instead of multiplying by it.
+
+Which leaves are sliced, and along which dimension, is decided by re-running
+the deterministic FSDP partition rule (``parallel/fsdp.py::shard_axis``) on
+each host leaf — the writer can never disagree with the train step about a
+leaf's layout.  The layout is recorded in every shard's manifest group:
+
+``{"world_size": axis_size, "rank": k, "group_step": step, "partial": true,
+"layout": {dotted-path: {"shape", "dtype", "axis", "parts"}}}``
+
+- shard 0 is the **canonical** file: the full nested state with each sliced
+  leaf replaced by its rank-0 slice (un-sliced leaves ride whole), so resume
+  selection, step parsing, and the doc'd tree-spec all keep working on it;
+- shards 1..k-1 are flat ``{dotted-path: slice}`` dicts — pure payload.
+
+Reassembly (:func:`load_sharded_checkpoint`) walks shard 0's structure and
+concatenates the recorded slices back along their recorded axis, returning
+the full host tree.  That tree is axis-size-agnostic: resuming under a
+*different* ``fsdp_axis_size`` (or pure DP) just re-places it under the new
+rule — resharding is free.
+
+Group completeness reuses the coordination layer unchanged ("rank" here is
+the model-axis shard index of a single-process run): a torn partial group is
+skipped at resume with ``ckpt_skipped reason=incomplete_group``, and
+group-aware ``keep_last`` pruning already deletes step groups atomically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from sheeprl_tpu.resilience.coordination import rank_shard_path
+from sheeprl_tpu.resilience.manifest import (
+    checkpoint_step,
+    read_manifest,
+    save_verified_checkpoint,
+)
+
+
+def _walk(node: Any, prefix: str, leaf_fn):
+    """Rebuild ``node`` with every array leaf passed through
+    ``leaf_fn(dotted_path, leaf)`` — same path grammar as
+    ``manifest.tree_spec`` (``a.b[0].c``), NamedTuples preserved."""
+    if isinstance(node, Mapping):
+        return {
+            key: _walk(value, f"{prefix}.{key}" if prefix else str(key), leaf_fn)
+            for key, value in node.items()
+        }
+    if isinstance(node, (list, tuple)):
+        items = [_walk(value, f"{prefix}[{i}]", leaf_fn) for i, value in enumerate(node)]
+        if isinstance(node, tuple):
+            return type(node)(*items) if hasattr(node, "_fields") else tuple(items)
+        return items
+    return leaf_fn(prefix, node)
+
+
+def partial_group_record(
+    axis_size: int, rank: int, step: Optional[int], layout: Mapping[str, Any]
+) -> Dict[str, Any]:
+    return {
+        "world_size": int(axis_size),
+        "rank": int(rank),
+        "group_step": step,
+        "partial": True,
+        "layout": dict(layout),
+    }
+
+
+def save_sharded_checkpoint(
+    path: str,
+    state: Mapping[str, Any],
+    axis_size: int,
+    min_shard_bytes: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Write ``state`` as an ``axis_size``-way partial-shard group.
+
+    Shards 1..k-1 land first, the canonical shard 0 last — a crash mid-group
+    either leaves no selectable candidate (shard 0 missing) or a torn group
+    the resume rule skips; it can never surface a half-group as resumable.
+    Returns ``{path, step, shards, bytes, bytes_shard0}``.
+    """
+    from sheeprl_tpu.parallel.fsdp import shard_axis
+
+    if axis_size <= 1:
+        raise ValueError(f"sharded save needs axis_size > 1, got {axis_size}")
+    path = str(path)
+    step = checkpoint_step(path, state)
+    layout: Dict[str, Dict[str, Any]] = {}
+    partials: List[Dict[str, Any]] = [dict() for _ in range(axis_size - 1)]
+
+    def slice_leaf(leaf_path: str, leaf: Any) -> Any:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            return leaf
+        axis = shard_axis(tuple(shape), dtype, axis_size, min_shard_bytes)
+        if axis is None:
+            return leaf
+        arr = np.asarray(leaf)
+        layout[leaf_path] = {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "axis": int(axis),
+            "parts": int(axis_size),
+        }
+        pieces = np.split(arr, axis_size, axis=axis)
+        for rank in range(1, axis_size):
+            partials[rank - 1][leaf_path] = pieces[rank]
+        return pieces[0]
+
+    shard0_state = _walk(state, "", slice_leaf)
+
+    total = 0
+    for rank in range(1, axis_size):
+        group = partial_group_record(axis_size, rank, step, layout)
+        result = save_verified_checkpoint(
+            rank_shard_path(path, rank), partials[rank - 1], step=step, group=group
+        )
+        total += result["bytes"]
+    group0 = partial_group_record(axis_size, 0, step, layout)
+    result0 = save_verified_checkpoint(path, shard0_state, step=step, group=group0)
+    total += result0["bytes"]
+    return {
+        "path": path,
+        "step": step,
+        "shards": axis_size,
+        "bytes": total,
+        "bytes_shard0": result0["bytes"],
+    }
+
+
+def partial_layout(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    """The partial-shard layout from a checkpoint's manifest group, or None
+    when the checkpoint is not a partial shard."""
+    entry = read_manifest(ckpt_path)
+    group = (entry or {}).get("group")
+    if not isinstance(group, Mapping) or not group.get("partial"):
+        return None
+    layout = group.get("layout")
+    return dict(layout) if isinstance(layout, Mapping) else {}
+
+
+def is_partial_checkpoint(ckpt_path: str) -> bool:
+    return partial_layout(ckpt_path) is not None
+
+
+def load_sharded_checkpoint(ckpt_path: str) -> Dict[str, Any]:
+    """Reassemble a partial-shard group into the full host state tree.
+
+    ``ckpt_path`` is the canonical shard 0.  The group is required to be
+    complete (every sibling present with the same ``group_step`` — shallow
+    check here; deep digest verification is resume selection's job); a torn
+    group raises instead of returning a silently-truncated tree.
+    """
+    from sheeprl_tpu.resilience.coordination import group_status
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    ckpt_path = str(ckpt_path)
+    entry = read_manifest(ckpt_path)
+    group = (entry or {}).get("group") or {}
+    layout = partial_layout(ckpt_path)
+    if layout is None:
+        raise ValueError(f"'{ckpt_path}' is not a partial-shard checkpoint")
+    complete, reason = group_status(ckpt_path, deep=False)
+    if not complete:
+        raise ValueError(f"partial-shard group for '{ckpt_path}' is torn ({reason})")
+    axis_size = int(group.get("world_size", 1) or 1)
+    shard0 = load_state(ckpt_path)
+    siblings = [load_state(rank_shard_path(ckpt_path, rank)) for rank in range(1, axis_size)]
+
+    def join_leaf(leaf_path: str, leaf: Any) -> Any:
+        record = layout.get(leaf_path)
+        if record is None:
+            return leaf
+        pieces = [np.asarray(leaf)]
+        for flat in siblings:
+            if leaf_path not in flat:
+                raise KeyError(f"shard is missing slice for '{leaf_path}'")
+            pieces.append(np.asarray(flat[leaf_path]))
+        full = np.concatenate(pieces, axis=int(record["axis"]))
+        if list(full.shape) != list(record["shape"]):
+            raise ValueError(
+                f"reassembled '{leaf_path}' has shape {list(full.shape)}, "
+                f"manifest records {record['shape']}"
+            )
+        return full
+
+    return _walk(shard0, "", join_leaf)

@@ -8,7 +8,10 @@ that died of an OOM) without TensorBoard or a live process:
   replay buffers plus the compiled train step's argument/output/activation-
   temp bytes and the device (or live-array) memory state;
 * the ``sharding_audit`` per-leaf bytes/sharding table, replicated arrays
-  flagged;
+  flagged (with the fsdp hint when large leaves stayed replicated);
+* the ``fsdp_shard_map`` layout summary when the run trained on a 2-D
+  ``("data", "model")`` mesh — per-tree sharded/replicated counts and the
+  global vs per-device footprint (howto/sharding.md);
 * the HBM gauge timeline (first/peak/last ``Telemetry/hbm_bytes_in_use``);
 * every ``host_transfer`` / ``donation_miss`` / ``oom`` event with its
   provenance — the OOM record carries the final memory snapshot taken before
@@ -33,6 +36,7 @@ from sheeprl_tpu.diagnostics.journal import find_journal, read_journal  # noqa: 
 from sheeprl_tpu.diagnostics.report import (  # noqa: E402
     format_bytes,
     format_event_line,
+    format_fsdp_shard_map,
     format_memory_breakdown,
     format_sharding_audit,
     memory_status_lines,
@@ -97,6 +101,11 @@ def report(path: str) -> int:
     if audit is not None:
         print()
         print(format_sharding_audit(audit))
+
+    shard_map = next((e for e in events if e.get("event") == "fsdp_shard_map"), None)
+    if shard_map is not None:
+        print()
+        print(format_fsdp_shard_map(shard_map))
 
     movement = [e for e in events if e.get("event") in ("host_transfer", "donation_miss")]
     if movement:
