@@ -1416,7 +1416,10 @@ def measure_serving(
 ):
     """Serving-tier block (ISSUE 11): requests/sec, p50/p99 latency and mean
     batch width at several offered-load points, measured through the REAL
-    HTTP tier (``POST /act``) by an in-process client swarm.
+    HTTP tier (``POST /act``) by an in-process client swarm.  Each point also
+    carries the per-phase breakdown (queue/dispatch p50·p99) and the SLO
+    burn-rate gauge from the service's phase stats, and the overload point
+    reports the mean shed-wait (ISSUE 19).
 
     The policy is a tiny randomly-initialized vector ppo agent — serving
     throughput is a property of the batcher + compiled-step pipeline, not of
@@ -1449,7 +1452,14 @@ def measure_serving(
     obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-20, 20, (obs_dim,), np.float32)})
     handle = build_policy(cfg, obs_space, gym.spaces.Discrete(6))
     service = PolicyService(
-        handle, {"batch_buckets": list(buckets), "max_delay_ms": float(max_delay_ms)}
+        handle,
+        {
+            "batch_buckets": list(buckets),
+            "max_delay_ms": float(max_delay_ms),
+            # an SLO target so each point also reports the burn-rate gauge
+            # (ISSUE 19); generous enough that a healthy CPU box sits near 0
+            "slo": {"target_ms": 250.0, "objective": 0.99},
+        },
     )
     service.start()
     service.warmup()
@@ -1529,6 +1539,11 @@ def measure_serving(
             rank = min(len(latencies) - 1, int(round(p / 100.0 * (len(latencies) - 1))))
             return round(latencies[rank], 3)
 
+        # per-phase breakdown + SLO burn from the service's own phase stats
+        # (ISSUE 19): the rolling window is dominated by this point's traffic
+        # (each point issues far more requests than the window holds), so the
+        # snapshot right after the swarm is this point's breakdown
+        gauges = (service.snapshot().get("gauges") or {})
         return {
             "clients": n_clients,
             "requests_per_sec": round(len(latencies) / wall, 2) if wall > 0 else None,
@@ -1536,6 +1551,11 @@ def measure_serving(
             "latency_p99_ms": pct(99.0),
             "batch_width_mean": round(d_req / d_disp, 3) if d_disp else None,
             "errors": sum(client_errors),
+            "queue_ms_p50": gauges.get("Telemetry/serve/queue_ms_p50"),
+            "queue_ms_p99": gauges.get("Telemetry/serve/queue_ms_p99"),
+            "dispatch_ms_p50": gauges.get("Telemetry/serve/dispatch_ms_p50"),
+            "dispatch_ms_p99": gauges.get("Telemetry/serve/dispatch_ms_p99"),
+            "slo_burn": gauges.get("Telemetry/serve/slo_burn"),
         }
 
     def overload_point(offered: int = 32, queue_limit: int = 4) -> dict:
@@ -1578,6 +1598,9 @@ def measure_serving(
             "accepted": outcome["ok"],
             "shed_503": outcome["shed"],
             "shed_total_delta": after["shed_total"] - before["shed_total"],
+            # mean time a shed request sat queued before its 503 (ISSUE 19):
+            # the client-visible cost of hitting the full queue
+            "shed_wait_ms": after.get("shed_wait_ms"),
             "retry_after_s": sorted(set(outcome["retry_after"])) or None,
         }
 

@@ -42,6 +42,42 @@ def _metric_name(key: str) -> str:
     return name
 
 
+def _format_le(le: Any) -> str:
+    if isinstance(le, str):
+        return le
+    return f"{float(le):g}"
+
+
+def latency_histogram_lines(hist: Mapping[str, Any], model: Optional[str] = None) -> list:
+    """Series lines (no ``# TYPE`` header — the caller owns the one-per-family
+    rule) for a per-phase latency histogram snapshot shaped like
+    ``PolicyService.snapshot()["latency_hist"]``:
+    ``{phase: {"buckets": [(le, cum_count), ...], "sum": ms, "count": n}}``.
+
+    Renders the standard Prometheus histogram triplet
+    ``sheeprl_serve_latency_ms_bucket{phase,le}`` / ``_sum`` / ``_count``,
+    with a ``model`` label prepended when serving multiple residents."""
+    lines = []
+    model_label = f'model="{_escape_label(model)}",' if model else ""
+    for phase in sorted(hist):
+        entry = hist[phase] or {}
+        phase_label = f'phase="{_escape_label(phase)}"'
+        for le, count in entry.get("buckets") or []:
+            lines.append(
+                f"sheeprl_serve_latency_ms_bucket"
+                f'{{{model_label}le="{_format_le(le)}",{phase_label}}} {float(count):g}'
+            )
+        lines.append(
+            f"sheeprl_serve_latency_ms_sum{{{model_label}{phase_label}}} "
+            f"{float(entry.get('sum') or 0.0):g}"
+        )
+        lines.append(
+            f"sheeprl_serve_latency_ms_count{{{model_label}{phase_label}}} "
+            f"{float(entry.get('count') or 0):g}"
+        )
+    return lines
+
+
 def render_prometheus(snapshot: Mapping[str, Any]) -> str:
     """Prometheus text exposition (0.0.4) of a telemetry snapshot.
 

@@ -292,6 +292,32 @@ def format_event_line(event: Dict[str, Any]) -> str:
         )
     if kind == "oom":
         return f"[{clock}] {kind:<12s} {payload.get('fn')} call #{payload.get('call')}: {str(payload.get('error', ''))[:80]}"
+    if kind == "slo_breach":
+        return (
+            f"[{clock}] {'!! SLO-BREACH':<12s} {payload.get('model') or 'default'}: "
+            f"burn {payload.get('burn')} (target {payload.get('target_ms')}ms, "
+            f"objective {payload.get('objective')}, window {payload.get('window')})"
+        )
+    if kind == "slo_breach_end":
+        breach_s = payload.get("breach_s")
+        took = f" after {breach_s:.0f}s" if isinstance(breach_s, (int, float)) else ""
+        return (
+            f"[{clock}] {kind:<12s} {payload.get('model') or 'default'} recovered{took} "
+            f"(burn {payload.get('burn')})"
+        )
+    if kind == "slow_request":
+        phases = payload.get("phases") or {}
+        breakdown = " + ".join(
+            f"{name.replace('_ms', '')} {phases[name]:.0f}"
+            for name in ("queue_ms", "batch_form_ms", "dispatch_ms", "scatter_ms")
+            if isinstance(phases.get(name), (int, float))
+        )
+        return (
+            f"[{clock}] {'!! SLOW-REQ':<12s} {payload.get('request_id')} on "
+            f"{payload.get('model') or 'default'}: {payload.get('total_ms')}ms "
+            f"({breakdown}ms; width {payload.get('batch_width')}, "
+            f"queue depth {payload.get('queue_depth')})"
+        )
     detail = " ".join(f"{k}={v}" for k, v in payload.items() if not isinstance(v, (dict, list)))
     return f"[{clock}] {kind:<12s} {detail}".rstrip()
 
@@ -337,6 +363,7 @@ def status_block(events: List[Dict[str, Any]]) -> str:
     lines.extend(health_status_lines(events, live=run_end is None))
     lines.extend(memory_status_lines(events))
     lines.extend(serving_status_lines(events, live=run_end is None))
+    lines.extend(serving_latency_lines(events, live=run_end is None))
     return "\n".join(lines)
 
 
@@ -684,6 +711,72 @@ def serving_status_lines(events: List[Dict[str, Any]], live: bool = True) -> Lis
         banner = sessions_full_banner(active, capacity)
         if banner is not None:
             lines.append(banner)
+    return lines
+
+
+def slo_burn_banner(model: str, burn: Any) -> Optional[str]:
+    """The ``!! SLO-BURN`` banner line (or None): ONE owner for the
+    threshold/wording so run_monitor's journal and endpoint modes can never
+    drift.  Fires while the rolling error-budget burn rate exceeds 1.0 —
+    the point at which the ``serving.slo.objective`` is being spent faster
+    than the window earns it back (howto/serving.md, "Tracing & SLOs")."""
+    if not isinstance(burn, (int, float)) or burn <= 1.0:
+        return None
+    return (
+        f"!! SLO-BURN — {model} is burning error budget at {burn:.2f}x "
+        "(>1.0 means the latency objective fails if this traffic holds)"
+    )
+
+
+def serving_latency_lines(events: List[Dict[str, Any]], live: bool = True) -> List[str]:
+    """The per-model latency-breakdown panel (run_monitor's journal AND
+    endpoint modes share it — the endpoint mode synthesizes journal-shaped
+    events from the labeled Prometheus series and feeds them here): queue /
+    dispatch / scatter p50·p99 from the latest heartbeat's
+    ``Telemetry/serve/*_ms_p50|p99`` gauges, the SLO burn gauge, and — live
+    mode only — the ``!! SLO-BURN`` banner past 1.0 plus a ``!! SLOW-REQ``
+    line naming the most recent journaled ``slow_request`` id.  Empty for
+    journals with no serving latency telemetry."""
+    last_by_model: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("event") != "metrics":
+            continue
+        metrics = e.get("metrics") or {}
+        if any(k.startswith("Telemetry/serve/") for k in metrics):
+            last_by_model[str(e.get("model") or "default")] = metrics
+    lines: List[str] = []
+    burns: Dict[str, Any] = {}
+    for model in sorted(last_by_model):
+        metrics = last_by_model[model]
+        parts: List[str] = []
+        for phase in ("queue", "dispatch", "scatter"):
+            p50 = metrics.get(f"Telemetry/serve/{phase}_ms_p50")
+            p99 = metrics.get(f"Telemetry/serve/{phase}_ms_p99")
+            if isinstance(p50, (int, float)) and isinstance(p99, (int, float)):
+                parts.append(f"{phase} {p50:.1f}/{p99:.1f}")
+        burn = metrics.get("Telemetry/serve/slo_burn")
+        if isinstance(burn, (int, float)):
+            parts.append(f"burn {burn:.2f}")
+            burns[model] = burn
+        shed_wait = metrics.get("Telemetry/serve/shed_wait_ms")
+        if isinstance(shed_wait, (int, float)):
+            parts.append(f"shed-wait {shed_wait:.1f}ms")
+        if parts:
+            lines.append(f"latency {model}: " + " · ".join(parts) + "  (p50/p99 ms)")
+    if live:
+        for model in sorted(burns):
+            banner = slo_burn_banner(model, burns[model])
+            if banner is not None:
+                lines.append(banner)
+        slow = next((e for e in reversed(events) if e.get("event") == "slow_request"), None)
+        if slow is not None:
+            total = slow.get("total_ms")
+            took = f" took {total}ms" if isinstance(total, (int, float)) else ""
+            lines.append(
+                f"!! SLOW-REQ — last slow request {slow.get('request_id')} on "
+                f"{slow.get('model') or 'default'}{took} "
+                "(full phase breakdown in the journal)"
+            )
     return lines
 
 

@@ -64,6 +64,9 @@ EVENT_KINDS: Dict[str, str] = {
     "ckpt_promote": "hot-reload promoted a new checkpoint (step, path, params version) — atomic swap, no recompile",
     "ckpt_reject": "hot-reload refused a checkpoint: health-gate anomalies, shape mismatch, or missing journal",
     "session_evict": "serving session layer: the LRU session lost its state-slab slot to a new session (session, slot, model, resident count vs capacity)",
+    "slo_breach": "serving SLO: the rolling burn rate stayed > 1.0 for `confirm` consecutive requests — model, burn, target_ms, objective, window (fsync'd)",
+    "slo_breach_end": "the serving SLO burn rate recovered to <= 1.0 (model, burn, seconds the breach lasted)",
+    "slow_request": "serving forensics: one request exceeded slo.slow_trace_ms — request id, model, full per-phase breakdown, batch width, queue depth at enqueue, session-eviction status (fsync'd)",
     "request_log_rotate": "serving request log: one shard of /act traffic rotated to disk (model, stream, rows, bytes, shard path) — or dropped=true when the writer queue was full",
     "ckpt_begin": "a checkpoint write started (path, step, blocking flag, seconds queued behind the async writer)",
     "ckpt_end": "a checkpoint write finished: bytes, write ms, manifest verified — or status=failed with the error",
@@ -201,4 +204,22 @@ METRICS: Dict[str, str] = {
     "sheeprl_sessions_created_total": "serving sessions: sessions allocated a slab slot (first sight or post-eviction re-entry)",
     "sheeprl_sessions_evictions_total": "serving sessions: LRU evictions journaled as session_evict",
     "sheeprl_sessions_overflow_total": "serving sessions: new sessions that rode the scratch slot because every slot was pinned by their own batch",
+    # request-level tracing, latency breakdown + SLOs (ISSUE 19): per-phase
+    # histograms with fixed serving.slo.buckets_ms boundaries, burn-rate
+    # gauge, shed-wait accounting and slow-request forensics counters
+    "sheeprl_serve_latency_ms_bucket": "serving: per-phase request-latency histogram buckets (labels: phase, le, optional model; boundaries from serving.slo.buckets_ms)",
+    "sheeprl_serve_latency_ms_sum": "serving: cumulative milliseconds observed per phase (histogram _sum)",
+    "sheeprl_serve_latency_ms_count": "serving: observations per phase (histogram _count)",
+    "sheeprl_serve_queue_ms_p50": "serving: median queue-wait (enqueue to batch-formation start) over the rolling window",
+    "sheeprl_serve_queue_ms_p99": "serving: p99 queue-wait",
+    "sheeprl_serve_batch_form_ms_p50": "serving: median batch-formation wait (co-rider window) over the rolling window",
+    "sheeprl_serve_batch_form_ms_p99": "serving: p99 batch-formation wait",
+    "sheeprl_serve_dispatch_ms_p50": "serving: median AOT dispatch time (slab assembly + session checkout + device step)",
+    "sheeprl_serve_dispatch_ms_p99": "serving: p99 AOT dispatch time",
+    "sheeprl_serve_scatter_ms_p50": "serving: median result fan-out time (dispatch return to every waiter woken)",
+    "sheeprl_serve_scatter_ms_p99": "serving: p99 result fan-out time",
+    "sheeprl_serve_slo_burn": "serving: rolling SLO burn rate — bad_fraction / (1 - objective); > 1.0 spends error budget faster than the objective allows",
+    "sheeprl_serve_shed_wait_ms": "serving: mean milliseconds a shed request spent queued/contended before its 503 (overload analysis without survivorship bias)",
+    "sheeprl_serve_slow_requests_total": "serving: requests that exceeded slo.slow_trace_ms and journaled slow_request forensics",
+    "sheeprl_serve_slo_breaches_total": "serving: confirmed SLO breaches journaled as slo_breach",
 }

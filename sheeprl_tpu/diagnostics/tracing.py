@@ -36,13 +36,23 @@ from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
 TRACE_NAME = "trace.json"
+# The serving tier writes its own file next to the serving journal so the
+# dispatcher/HTTP-handler spans never interleave with a co-located training
+# trace; tools/trace_report.py merges both onto one absolute timeline via
+# their clock_sync anchors (howto/serving.md "Tracing & SLOs").
+TRACE_SERVE_NAME = "trace_serve.json"
 
-# Span names the training loops emit (free-form names are fine too; these are
-# the vocabulary howto/diagnostics.md documents).  ``env_step_async`` times
-# issuing the split-phase env dispatch and ``env_wait`` the blocking collect —
-# in Perfetto the gap between an ``env_step_async`` span and its iteration's
-# ``env_wait`` span is exactly the env time hidden behind device dispatch, so
-# the async env pipeline's overlap (howto/async_envs.md) is directly visible.
+# Span names the training loops and the serving tier emit (free-form names
+# are fine too; these are the vocabulary howto/diagnostics.md documents).
+# ``env_step_async`` times issuing the split-phase env dispatch and
+# ``env_wait`` the blocking collect — in Perfetto the gap between an
+# ``env_step_async`` span and its iteration's ``env_wait`` span is exactly
+# the env time hidden behind device dispatch, so the async env pipeline's
+# overlap (howto/async_envs.md) is directly visible.  The ``serve-*`` phases
+# tile one /act request: queue-wait → batch formation → (session checkout
+# inside) AOT dispatch → result scatter → response serialization, plus the
+# request-log writer thread's shard flush.  tools/lint TRC501 pins every
+# span-name literal in serving/ and the loops to this tuple.
 KNOWN_PHASES = (
     "rollout",
     "env_step_async",
@@ -50,6 +60,13 @@ KNOWN_PHASES = (
     "buffer-sample",
     "train",
     "checkpoint",
+    "serve-queue",
+    "serve-batch-form",
+    "serve-session-checkout",
+    "serve-dispatch",
+    "serve-scatter",
+    "serve-serialize",
+    "serve-request-log",
 )
 
 
@@ -195,6 +212,30 @@ class PhaseTracer:
                 }
             )
 
+    def now_us(self) -> int:
+        """Current trace-clock reading (µs since this tracer's ts=0).
+
+        Callers that can only attribute a phase after the fact (the batcher
+        learns a request's queue-wait when the dispatcher pops it) capture
+        timestamps with this and emit retroactively via :meth:`emit_complete`.
+        """
+        return self._now_us()
+
+    def emit_complete(self, name: str, ts_us: int, dur_us: int, **args: Any) -> None:
+        """Emit a complete ("X") event at explicit trace-clock coordinates."""
+        self._emit(
+            {
+                "name": str(name),
+                "cat": "phase",
+                "ph": "X",
+                "ts": int(ts_us),
+                "dur": max(0, int(dur_us)),
+                "pid": self._pid,
+                "tid": threading.get_ident() % (1 << 31),
+                **({"args": args} if args else {}),
+            }
+        )
+
     def instant(self, name: str, **args: Any) -> None:
         """Mark a point event (checkpoint written, divergence detected...)."""
         self._emit(
@@ -231,6 +272,12 @@ class NullTracer:
     @contextmanager
     def span(self, name: str, **args: Any):
         yield
+
+    def now_us(self) -> int:
+        return 0
+
+    def emit_complete(self, name: str, ts_us: int, dur_us: int, **args: Any) -> None:
+        pass
 
     def instant(self, name: str, **args: Any) -> None:
         pass

@@ -103,6 +103,7 @@ def render_registry_metrics(registry: ModelRegistry) -> str:
         METRIC_PREFIX,
         _escape_label,
         _metric_name,
+        latency_histogram_lines,
     )
 
     entries = registry.entries()
@@ -189,4 +190,38 @@ def render_registry_metrics(registry: ModelRegistry) -> str:
         lines.extend(width_lines)
         for width, count in sorted(width_totals.items()):
             lines.append(f'sheeprl_serve_batch_width_total{{width="{width}"}} {count:g}')
+
+    # the per-phase latency histogram: {model, phase, le} series + unlabeled
+    # {phase, le} aggregate (bucket boundaries are fixed by config, so
+    # cumulative counts sum across models without re-binning)
+    agg: Dict[str, Dict[str, Any]] = {}
+    hist_lines: List[str] = []
+    for model in sorted(snaps):
+        hist = snaps[model].get("latency_hist") or {}
+        hist_lines.extend(latency_histogram_lines(hist, model=model))
+        for phase, entry in hist.items():
+            slot = agg.setdefault(phase, {"buckets": {}, "sum": 0.0, "count": 0})
+            for le, count in entry.get("buckets") or []:
+                key = str(le)
+                slot["buckets"][key] = (le, slot["buckets"].get(key, (le, 0))[1] + count)
+            slot["sum"] += float(entry.get("sum") or 0.0)
+            slot["count"] += int(entry.get("count") or 0)
+    if hist_lines:
+        lines.append("# TYPE sheeprl_serve_latency_ms histogram")
+        lines.extend(hist_lines)
+        if len(snaps) > 1:
+            agg_hist = {
+                phase: {
+                    "buckets": list(slot["buckets"].values()),
+                    "sum": slot["sum"],
+                    "count": slot["count"],
+                }
+                for phase, slot in agg.items()
+            }
+            lines.extend(latency_histogram_lines(agg_hist))
+        else:
+            # single model: the labeled series already tell the whole story;
+            # re-render them unlabeled so single-model tooling needs no labels
+            only = next(iter(snaps.values()), {})
+            lines.extend(latency_histogram_lines(only.get("latency_hist") or {}))
     return "\n".join(lines) + "\n"

@@ -50,12 +50,14 @@ class RequestLog:
         journal: Any = None,
         stream: int = 0,
         extra_meta: Optional[Mapping[str, Any]] = None,
+        tracer: Any = None,
     ):
         self.root = str(root)
         self.model = model
         self.rotate_rows = max(1, int(rotate_rows))
         self.stream = int(stream)
         self._journal = journal
+        self._tracer = tracer
         self._handle = handle
         self._lock = threading.Lock()
         self._buffer: List[Dict[str, np.ndarray]] = []
@@ -145,7 +147,13 @@ class RequestLog:
             if rows is None:
                 return
             try:
-                self._write_shard(rows)
+                if self._tracer is not None:
+                    # shard I/O shows up on the writer thread's track of the
+                    # serving trace, next to the request spans it rode behind
+                    with self._tracer.span("serve-request-log", rows=len(rows), model=self.model):
+                        self._write_shard(rows)
+                else:
+                    self._write_shard(rows)
             except Exception:  # noqa: BLE001 - logging must outlive bad disks
                 with self._lock:
                     self.dropped_total += len(rows)

@@ -42,12 +42,21 @@ import json
 import os
 import threading
 import time
+import uuid
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
 
-from sheeprl_tpu.serving.batcher import DEFAULT_BUCKETS, DynamicBatcher, ServeError, pick_bucket
+from sheeprl_tpu.diagnostics.tracing import TRACE_SERVE_NAME, NullTracer, PhaseTracer
+from sheeprl_tpu.serving.batcher import (
+    DEFAULT_BUCKETS,
+    DynamicBatcher,
+    ServeError,
+    _percentile,
+    pick_bucket,
+)
 from sheeprl_tpu.serving.loader import (
     PolicyHandle,
     agent_state_from_checkpoint,
@@ -61,6 +70,162 @@ from sheeprl_tpu.serving.sessions import SessionStore, make_slab_step
 
 SERVE_GAUGE_PREFIX = "Telemetry/serve/"
 SESSIONS_GAUGE_PREFIX = "Telemetry/sessions/"
+
+#: fallback when ``serving.slo.buckets_ms`` is absent
+#: (``configs/serving/default.yaml`` mirrors this).  An ALL-CAPS module
+#: constant is the one place lint TRC502 allows bucket boundaries to live
+#: outside config — call sites must take them from here or from cfg.
+DEFAULT_SLO_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0)
+
+
+class PhaseStats:
+    """Per-phase latency accounting for one model: rolling p50/p99 windows
+    for the live gauges plus cumulative Prometheus histogram counts
+    (``sheeprl_serve_latency_ms_bucket{phase,le}``) with fixed bucket
+    boundaries from ``serving.slo.buckets_ms`` — fixed, so series from
+    different scrapes/models stay mergeable."""
+
+    PHASES = ("queue", "batch_form", "dispatch", "scatter", "total")
+
+    def __init__(self, buckets_ms: Any = None):
+        self.buckets_ms = tuple(float(b) for b in (buckets_ms or DEFAULT_SLO_BUCKETS_MS))
+        if list(self.buckets_ms) != sorted(self.buckets_ms):
+            raise ValueError(f"serving.slo.buckets_ms must be ascending, got {list(self.buckets_ms)}")
+        self._lock = threading.Lock()
+        self._window: Dict[str, deque] = {p: deque(maxlen=1024) for p in self.PHASES}
+        # per-bin (non-cumulative) counts; the +1 bin is +Inf
+        self._bins: Dict[str, List[int]] = {p: [0] * (len(self.buckets_ms) + 1) for p in self.PHASES}
+        self._sum: Dict[str, float] = {p: 0.0 for p in self.PHASES}
+        self._count: Dict[str, int] = {p: 0 for p in self.PHASES}
+
+    def observe(self, phase: str, value_ms: float) -> None:
+        value = max(0.0, float(value_ms))
+        bin_i = len(self.buckets_ms)
+        for i, le in enumerate(self.buckets_ms):
+            if value <= le:
+                bin_i = i
+                break
+        with self._lock:
+            self._window[phase].append(value)
+            self._bins[phase][bin_i] += 1
+            self._sum[phase] += value
+            self._count[phase] += 1
+
+    def percentiles(self) -> Dict[str, Tuple[float, float]]:
+        """``{phase: (p50_ms, p99_ms)}`` over the rolling window (phases with
+        no observations yet are omitted)."""
+        out: Dict[str, Tuple[float, float]] = {}
+        with self._lock:
+            windows = {p: sorted(w) for p, w in self._window.items() if w}
+        for phase, values in windows.items():
+            out[phase] = (
+                round(_percentile(values, 50.0), 3),
+                round(_percentile(values, 99.0), 3),
+            )
+        return out
+
+    def histogram(self) -> Dict[str, Dict[str, Any]]:
+        """Cumulative-bucket snapshot per phase:
+        ``{phase: {"buckets": [(le, cum_count), ..., ("+Inf", total)],
+        "sum": ms, "count": n}}`` — exactly the Prometheus histogram shape."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for phase in self.PHASES:
+                if not self._count[phase]:
+                    continue
+                cum = 0
+                buckets: List[Tuple[Any, int]] = []
+                for le, n in zip(self.buckets_ms, self._bins[phase]):
+                    cum += n
+                    buckets.append((le, cum))
+                cum += self._bins[phase][-1]
+                buckets.append(("+Inf", cum))
+                out[phase] = {
+                    "buckets": buckets,
+                    "sum": round(self._sum[phase], 3),
+                    "count": self._count[phase],
+                }
+        return out
+
+
+class SloMonitor:
+    """Rolling-window latency SLO: burn rate + flood-controlled breach
+    journaling.
+
+    Every completed request is classified against ``target_ms``; the burn
+    rate is ``bad_fraction / (1 - objective)`` over the last ``window``
+    requests (>1.0 = the error budget is being spent faster than the
+    objective allows).  Breaches follow the ``diagnostics/health.py``
+    confirm-window discipline: ``confirm`` consecutive burn>1 observations
+    journal ONE fsync'd ``slo_breach``, recovery journals ``slo_breach_end``,
+    and nothing repeats while the breach is active."""
+
+    def __init__(
+        self,
+        target_ms: Optional[float] = None,
+        objective: float = 0.99,
+        window: int = 256,
+        confirm: int = 8,
+        journal: Any = None,
+        model: Optional[str] = None,
+    ):
+        self.target_ms = None if target_ms is None else float(target_ms)
+        self.objective = min(0.99999, max(0.0, float(objective)))
+        self._journal = journal
+        self.model = model
+        self._window: deque = deque(maxlen=max(1, int(window)))
+        self._confirm = max(1, int(confirm))
+        self._lock = threading.Lock()
+        self._breaches = 0
+        self.active = False
+        self.breaches_total = 0
+        self.burn = 0.0
+        self._active_since_t: Optional[float] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.target_ms is not None
+
+    def observe(self, total_ms: float) -> float:
+        if self.target_ms is None:
+            return 0.0
+        with self._lock:
+            self._window.append(float(total_ms) > self.target_ms)
+            bad = sum(self._window)
+            budget = max(1e-9, 1.0 - self.objective)
+            self.burn = (bad / len(self._window)) / budget
+            burn = self.burn
+            if burn > 1.0:
+                self._breaches += 1
+                if self._breaches >= self._confirm and not self.active:
+                    self.active = True
+                    self.breaches_total += 1
+                    self._active_since_t = time.time()
+                    if self._journal is not None:
+                        self._journal.write(
+                            "slo_breach",
+                            model=self.model,
+                            burn=round(burn, 4),
+                            target_ms=self.target_ms,
+                            objective=self.objective,
+                            window=len(self._window),
+                            confirm=self._confirm,
+                        )
+                        self._journal.sync()
+            else:
+                self._breaches = 0
+                if self.active:
+                    self.active = False
+                    since = self._active_since_t
+                    self._active_since_t = None
+                    if self._journal is not None:
+                        self._journal.write(
+                            "slo_breach_end",
+                            model=self.model,
+                            burn=round(burn, 4),
+                            breach_s=None if since is None else round(time.time() - since, 3),
+                        )
+        return burn
 
 
 class PolicyService:
@@ -85,20 +250,50 @@ class PolicyService:
         journal: Any = None,
         aot: bool = True,
         model: Optional[str] = None,
+        tracer: Any = None,
+        inject_slow_iter: Optional[int] = None,
     ):
         cfg = dict(serving_cfg or {})
         self.handle = handle
         self._journal = journal
         self._aot = bool(aot)
         self.model = model
+        self._tracer = tracer if tracer is not None else NullTracer()
         self.default_greedy = bool(cfg.get("greedy", True))
         buckets = cfg.get("batch_buckets") or list(DEFAULT_BUCKETS)
         self.buckets = tuple(sorted(int(b) for b in buckets))
+        # latency breakdown + SLO layer (ISSUE 19): the batcher reports every
+        # completed request's phase tiling back through _on_request_done
+        slo_cfg = dict(cfg.get("slo") or {})
+        self.phase_stats = PhaseStats(slo_cfg.get("buckets_ms"))
+        self.slo = SloMonitor(
+            target_ms=slo_cfg.get("target_ms"),
+            objective=float(slo_cfg.get("objective", 0.99)),
+            window=int(slo_cfg.get("window", 256)),
+            confirm=int(slo_cfg.get("confirm", 8)),
+            journal=journal,
+            model=model,
+        )
+        self.slow_trace_ms = (
+            None if slo_cfg.get("slow_trace_ms") is None else float(slo_cfg["slow_trace_ms"])
+        )
+        self.slow_requests_total = 0
+        self.last_slow_request_id: Optional[str] = None
+        self._inject_slow_iter = None if inject_slow_iter is None else int(inject_slow_iter)
+        if self._inject_slow_iter is not None and self.slow_trace_ms is None:
+            # a drill that can never journal its slow_request is a config
+            # error, not a silent no-op (the health.py inject discipline)
+            raise ValueError(
+                "diagnostics.serving.inject_slow_iter requires serving.slo.slow_trace_ms "
+                "to be set; the drill exists to fire the slow_request path"
+            )
         self.batcher = DynamicBatcher(
             self._dispatch,
             buckets=self.buckets,
             max_delay_ms=float(cfg.get("max_delay_ms", 5.0)),
             max_queue=int(cfg.get("max_queue", 4096)),
+            tracer=self._tracer,
+            on_request_done=self._on_request_done,
         )
         self.sessions: Optional[SessionStore] = None
         if getattr(handle, "stateful", False):
@@ -109,6 +304,7 @@ class PolicyService:
                 journal=journal,
                 model=model,
                 device=self._aot,
+                tracer=self._tracer,
             )
         # set by ServeApp when serving.request_log.enabled; the dispatch
         # appends every valid row after slicing off the padding
@@ -226,6 +422,24 @@ class PolicyService:
         return jax.random.fold_in(self._base_key, self._dispatch_counter)
 
     # -- dispatch (called from the batcher thread) -------------------------
+    def _maybe_inject_slow(self) -> None:
+        """``diagnostics.serving.inject_slow_iter`` fault drill: make exactly
+        one dispatch (the Nth) sleep well past ``slo.slow_trace_ms`` so the
+        slow_request -> slo_breach -> slo_breach_end chain fires through the
+        real request path (journaled like every other injected fault)."""
+        if self._inject_slow_iter is None or self._dispatch_counter != self._inject_slow_iter:
+            return
+        delay_s = max(0.05, 2.0 * float(self.slow_trace_ms or 0.0) / 1000.0)
+        if self._journal is not None:
+            self._journal.write(
+                "fault_injection",
+                kind="slow_dispatch",
+                model=self.model,
+                dispatch_id=self._dispatch_counter,
+                delay_s=round(delay_s, 3),
+            )
+        time.sleep(delay_s)
+
     def _dispatch(self, rows: List[Dict[str, Any]], greedy: bool) -> Tuple[Any, Dict[str, Any]]:
         width = pick_bucket(len(rows), self.buckets)
         if self.sessions is not None:
@@ -240,6 +454,7 @@ class PolicyService:
         if self._step_delay_s:
             time.sleep(self._step_delay_s)
         self._dispatch_counter += 1
+        self._maybe_inject_slow()
         fn = self._compiled_step(width, greedy)
         if self._aot:
             import jax
@@ -278,7 +493,8 @@ class PolicyService:
         if self._step_delay_s:
             time.sleep(self._step_delay_s)
         self._dispatch_counter += 1
-        idx, is_first, _ = self.sessions.checkout(
+        self._maybe_inject_slow()
+        idx, is_first, evicted = self.sessions.checkout(
             [r.get("session") for r in rows], [bool(r.get("reset")) for r in rows], width
         )
         fn = self._compiled_step(width, greedy)
@@ -304,6 +520,7 @@ class PolicyService:
             "batch_rows": len(rows),
             "dispatch_id": self._dispatch_counter,
             "sessions_active": self.sessions.active,
+            "session_evictions": len(evicted),
         }
         valid = out[: len(rows)]
         if self.request_log is not None:
@@ -318,6 +535,7 @@ class PolicyService:
         timeout_s: float = 30.0,
         session: Optional[str] = None,
         reset: bool = False,
+        request_id: Optional[str] = None,
     ) -> Dict[str, Any]:
         row = self.handle.validate(obs)
         use_greedy = self.default_greedy if greedy is None else bool(greedy)
@@ -328,7 +546,7 @@ class PolicyService:
                     f"algorithm {self.handle.algo!r} serves statelessly; "
                     "'session' is only valid for recurrent/model-based policies",
                 )
-            return self.batcher.submit(row, use_greedy, timeout_s=timeout_s)
+            return self.batcher.submit(row, use_greedy, timeout_s=timeout_s, request_id=request_id)
         sid = None if session is None else str(session)
         # a non-None group key keeps one session's rows out of the same
         # dispatch: its slab slot is gathered at most once per batch, so
@@ -338,7 +556,47 @@ class PolicyService:
             use_greedy,
             timeout_s=timeout_s,
             group_key=None if sid is None else ("session", sid),
+            request_id=request_id,
         )
+
+    # -- latency/SLO accounting (called from the batcher thread) -----------
+    def _on_request_done(self, done: Dict[str, Any]) -> None:
+        """Per-completed-request hook: feed the phase histograms, the SLO
+        burn window, and — past ``slo.slow_trace_ms`` — journal the one
+        fsync'd ``slow_request`` forensics event with the full breakdown."""
+        phases = dict(done.get("phases") or {})
+        total_ms = float(done.get("total_ms") or 0.0)
+        self.phase_stats.observe("queue", phases.get("queue_ms", 0.0))
+        self.phase_stats.observe("batch_form", phases.get("batch_form_ms", 0.0))
+        self.phase_stats.observe("dispatch", phases.get("dispatch_ms", 0.0))
+        self.phase_stats.observe("scatter", phases.get("scatter_ms", 0.0))
+        self.phase_stats.observe("total", total_ms)
+        self.slo.observe(total_ms)
+        if self.slow_trace_ms is None or total_ms <= self.slow_trace_ms:
+            return
+        meta = dict(done.get("meta") or {})
+        rid = done.get("request_id")
+        self.slow_requests_total += 1
+        self.last_slow_request_id = rid
+        self.info["last_slow_request_id"] = rid
+        if self._journal is not None:
+            self._journal.write(
+                "slow_request",
+                request_id=rid,
+                model=self.model,
+                total_ms=round(total_ms, 3),
+                phases={k: round(float(v), 3) for k, v in phases.items()},
+                batch_width=done.get("width"),
+                batch_rows=done.get("rows"),
+                queue_depth=done.get("queue_depth"),
+                sessions_active=meta.get("sessions_active"),
+                session_evictions=meta.get("session_evictions"),
+                dispatch_id=meta.get("dispatch_id"),
+                ckpt_step=meta.get("ckpt_step"),
+                timed_out=bool(done.get("abandoned")),
+            )
+            # forensics must survive a crash right after the slow request
+            self._journal.sync()
 
     def drop_session(self, session: str) -> bool:
         """Explicit session release (``/act`` is fire-and-forget; LRU evicts
@@ -370,6 +628,10 @@ class PolicyService:
                 "ckpt_promote", step=int(step), path=str(path), source=source,
                 params_version=self._params_version, model=self.model,
             )
+        # a full-height marker on the serving trace: after the trace_report
+        # merge, the promotion is visible IN BETWEEN request spans, on the
+        # same absolute clock as the training run that wrote the checkpoint
+        self._tracer.instant("ckpt_promote", step=int(step), model=self.model)
         return True
 
     def reject(self, path: str, reason: str, anomalies: Optional[List[Dict[str, Any]]] = None) -> None:
@@ -425,9 +687,19 @@ class PolicyService:
             ("latency_p99_ms", "latency_p99_ms"),
             ("requests_per_sec", "requests_per_sec"),
             ("batch_width_mean", "batch_width_mean"),
+            ("shed_wait_ms", "shed_wait_ms"),
         ):
             if src in stats:
                 gauges[SERVE_GAUGE_PREFIX + name] = stats[src]
+        # per-phase p50/p99 gauges ("total" already headlines as
+        # latency_p50/p99_ms above — from the same accounting window)
+        for phase, (p50, p99) in self.phase_stats.percentiles().items():
+            if phase == "total":
+                continue
+            gauges[SERVE_GAUGE_PREFIX + f"{phase}_ms_p50"] = p50
+            gauges[SERVE_GAUGE_PREFIX + f"{phase}_ms_p99"] = p99
+        if self.slo.enabled:
+            gauges[SERVE_GAUGE_PREFIX + "slo_burn"] = round(self.slo.burn, 4)
         counters: Dict[str, Any] = {
             "serve_requests_total": stats["requests_total"],
             "serve_dispatches_total": stats["dispatches_total"],
@@ -435,6 +707,8 @@ class PolicyService:
             "serve_shed_total": stats["shed_total"],
             "serve_ckpt_promotions_total": self.promotions_total,
             "serve_ckpt_rejections_total": self.rejections_total,
+            "serve_slow_requests_total": self.slow_requests_total,
+            "serve_slo_breaches_total": self.slo.breaches_total,
         }
         if self.sessions is not None:
             gauges[SESSIONS_GAUGE_PREFIX + "active"] = self.sessions.active
@@ -451,6 +725,7 @@ class PolicyService:
             "gauges": gauges,
             "counters": counters,
             "batch_width_hist": stats["width_hist"],
+            "latency_hist": self.phase_stats.histogram(),
         }
 
 
@@ -459,7 +734,7 @@ def render_serving_metrics(snapshot: Mapping[str, Any]) -> str:
     plus the batch-width histogram as a labeled counter family.  The app's
     ``/metrics`` endpoint renders the whole registry instead
     (:func:`~sheeprl_tpu.serving.registry.render_registry_metrics`)."""
-    from sheeprl_tpu.diagnostics.metrics_server import render_prometheus
+    from sheeprl_tpu.diagnostics.metrics_server import latency_histogram_lines, render_prometheus
 
     body = render_prometheus(snapshot)
     hist = snapshot.get("batch_width_hist") or {}
@@ -467,6 +742,11 @@ def render_serving_metrics(snapshot: Mapping[str, Any]) -> str:
         lines = ["# TYPE sheeprl_serve_batch_width_total counter"]
         for width, count in sorted(hist.items()):
             lines.append(f'sheeprl_serve_batch_width_total{{width="{int(width)}"}} {int(count)}')
+        body += "\n".join(lines) + "\n"
+    lat_hist = snapshot.get("latency_hist") or {}
+    if lat_hist:
+        lines = ["# TYPE sheeprl_serve_latency_ms histogram"]
+        lines.extend(latency_histogram_lines(lat_hist))
         body += "\n".join(lines) + "\n"
     return body
 
@@ -529,7 +809,10 @@ class CheckpointWatcher(threading.Thread):
                     snap = self.service.snapshot()
                     stats = self.service.batcher.stats()
                     self._journal.write(
-                        "metrics", step=stats["requests_total"], metrics=snap["gauges"]
+                        "metrics",
+                        step=stats["requests_total"],
+                        metrics=snap["gauges"],
+                        model=self.service.model,
                     )
 
     def check_once(self) -> Optional[bool]:
@@ -635,6 +918,22 @@ class ServeApp:
         from sheeprl_tpu.diagnostics.journal import JOURNAL_NAME, RunJournal
 
         self.journal = RunJournal(os.path.join(self.log_dir, JOURNAL_NAME))
+        # per-request serving trace (trace_serve.json): the batcher dispatcher
+        # threads and the HTTP handler threads all write spans into this one
+        # tracer; its clock_sync anchor (role=server) is what lets
+        # tools/trace_report.py merge the serving timeline with the training
+        # run's trace.json onto one absolute clock
+        trace_cfg = dict(serving_cfg.get("trace") or {})
+        if trace_cfg.get("enabled", True):
+            self.tracer: Any = PhaseTracer(
+                os.path.join(self.log_dir, TRACE_SERVE_NAME),
+                run_id=os.path.basename(self.log_dir),
+                role="server",
+                max_events=trace_cfg.get("max_events"),
+                rotate_keep=int(trace_cfg.get("rotate_keep", 2)),
+            )
+        else:
+            self.tracer = NullTracer()
         self.registry = ModelRegistry()
         self._add_model("default", cfg, str(ckpt_path), watch_dir=watch_dir, default=True)
         for name in sorted(serving_cfg.get("models") or {}):
@@ -667,8 +966,16 @@ class ServeApp:
     ) -> ModelEntry:
         serving_cfg = dict(cfg.get("serving") or {})
         reload_cfg = dict(serving_cfg.get("reload") or {})
+        diag_serving = dict((cfg.get("diagnostics") or {}).get("serving") or {})
         handle = load_policy(cfg, ckpt_path)
-        service = PolicyService(handle, serving_cfg, journal=self.journal, model=name)
+        service = PolicyService(
+            handle,
+            serving_cfg,
+            journal=self.journal,
+            model=name,
+            tracer=self.tracer,
+            inject_slow_iter=diag_serving.get("inject_slow_iter"),
+        )
         service.info["env"] = (cfg.get("env") or {}).get("id")
         service.info["run_id"] = os.path.basename(self.log_dir)
         request_log = None
@@ -683,6 +990,7 @@ class ServeApp:
                 model=name,
                 rotate_rows=int(rl_cfg.get("rotate_rows", 4096)),
                 journal=self.journal,
+                tracer=self.tracer,
             )
             service.request_log = request_log
         watcher = None
@@ -729,6 +1037,7 @@ class ServeApp:
     def start(self) -> Tuple[str, int]:
         registry = self.registry
         timeout_s = self.request_timeout_s
+        tracer = self.tracer
         for entry in registry.entries():
             entry.service.start()
             if self._warmup:
@@ -757,6 +1066,12 @@ class ServeApp:
                 if self.path.partition("?")[0] != "/act":
                     self._reply(404, b'{"error": "not found"}')
                     return
+                # request identity for tracing/forensics: honor the client's
+                # X-Request-Id (so an edge proxy's id threads through to the
+                # slow_request journal and the trace spans), generate else;
+                # always echoed back as a response header
+                request_id = str(self.headers.get("X-Request-Id") or uuid.uuid4().hex[:16])
+                rid_header = {"X-Request-Id": request_id}
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     payload = json.loads(self.rfile.read(length) or b"{}")
@@ -767,28 +1082,29 @@ class ServeApp:
                         timeout_s=min(timeout_s, float(payload.get("timeout_s") or timeout_s)),
                         session=payload.get("session"),
                         reset=bool(payload.get("reset", False)),
+                        request_id=request_id,
                     )
                 except ServeError as err:
-                    headers = (
-                        {"Retry-After": str(err.retry_after)}
-                        if err.retry_after is not None
-                        else None
-                    )
+                    headers = dict(rid_header)
+                    if err.retry_after is not None:
+                        headers["Retry-After"] = str(err.retry_after)
                     self._reply(
                         err.status, json.dumps({"error": str(err)}).encode(), headers=headers
                     )
                     return
                 except (ValueError, TypeError, json.JSONDecodeError) as err:
-                    self._reply(400, json.dumps({"error": str(err)}).encode())
+                    self._reply(400, json.dumps({"error": str(err)}).encode(), headers=rid_header)
                     return
                 except Exception as err:  # noqa: BLE001 - handler must answer
-                    self._reply(500, json.dumps({"error": repr(err)}).encode())
+                    self._reply(500, json.dumps({"error": repr(err)}).encode(), headers=rid_header)
                     return
-                body = {
-                    "action": np.asarray(result["action"]).tolist(),
-                    **{k: v for k, v in result.items() if k != "action"},
-                }
-                self._reply(200, json.dumps(body).encode())
+                with tracer.span("serve-serialize", request_id=request_id):
+                    body = {
+                        "action": np.asarray(result["action"]).tolist(),
+                        **{k: v for k, v in result.items() if k != "action"},
+                    }
+                    encoded = json.dumps(body).encode()
+                self._reply(200, encoded, headers=rid_header)
 
             def do_GET(self) -> None:  # noqa: N802 - stdlib API
                 path = self.path.partition("?")[0]
@@ -888,9 +1204,15 @@ class ServeApp:
             entry.service.close()  # closes the request log too
             entry.request_log = None
         stats = self.service.batcher.stats()
-        self.journal.write("metrics", step=stats["requests_total"], metrics=self.service.snapshot()["gauges"])
+        self.journal.write(
+            "metrics",
+            step=stats["requests_total"],
+            metrics=self.service.snapshot()["gauges"],
+            model=self.service.model,
+        )
         self.journal.write("run_end", status=status)
         self.journal.close()
+        self.tracer.close()
 
 
 def serve_checkpoint(cfg, ckpt_path: str, watch_dir: Optional[str] = None) -> None:

@@ -76,6 +76,7 @@ class SessionStore:
         journal: Any = None,
         model: Optional[str] = None,
         device: bool = True,
+        tracer: Any = None,
     ):
         if capacity <= 0:
             raise ValueError(f"sessions.capacity must be > 0, got {capacity}")
@@ -83,6 +84,7 @@ class SessionStore:
         self.scratch = self.capacity  # slot index of the scratch row
         self.state_spec = dict(state_spec)
         self._journal = journal
+        self._tracer = tracer
         self.model = model
         self._device = bool(device)
         rows = self.capacity + 1
@@ -114,6 +116,17 @@ class SessionStore:
         ``is_first = 1``; so do sessionless rows and — when every slot is
         pinned by this very batch — overflow sessions (which then simply are
         not resident yet; they allocate on a later dispatch)."""
+        if self._tracer is not None:
+            with self._tracer.span("serve-session-checkout", rows=len(session_ids)):
+                return self._checkout(session_ids, resets, width)
+        return self._checkout(session_ids, resets, width)
+
+    def _checkout(
+        self,
+        session_ids: Sequence[Optional[str]],
+        resets: Sequence[bool],
+        width: int,
+    ) -> Tuple[np.ndarray, np.ndarray, List[Dict[str, Any]]]:
         idx = np.full((int(width),), self.scratch, dtype=np.int32)
         is_first = np.ones((int(width), 1), dtype=np.float32)
         evicted: List[Dict[str, Any]] = []

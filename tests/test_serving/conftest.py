@@ -104,9 +104,13 @@ class JournalStub:
 
     def __init__(self) -> None:
         self.events: List[Dict[str, Any]] = []
+        self.syncs = 0
 
     def write(self, kind: str, **fields: Any) -> None:
         self.events.append({"event": kind, **fields})
+
+    def sync(self) -> None:
+        self.syncs += 1
 
     def kinds(self) -> List[str]:
         return [e["event"] for e in self.events]

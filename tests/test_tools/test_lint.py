@@ -25,7 +25,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 sys.path.insert(0, str(REPO_ROOT / "tools"))
 
 from lint import Finding, apply_baseline, load_baseline, run_passes, write_baseline  # noqa: E402
-from lint import asy_pass, cfg_pass, ins_pass, jit_pass, jrn_pass  # noqa: E402
+from lint import asy_pass, cfg_pass, ins_pass, jit_pass, jrn_pass, trc_pass  # noqa: E402
 from lint.loader import RepoIndex  # noqa: E402
 
 
@@ -533,6 +533,92 @@ def loop(envs, a):
 
 
 # ---------------------------------------------------------------------------
+# TRC — trace-span vocabulary & bucket hygiene
+
+
+TRC_TRACING = """\
+KNOWN_PHASES = (
+    "rollout",
+    "train",
+    "serve-dispatch",
+)
+"""
+
+
+def _trc_index(extra):
+    return RepoIndex.from_sources(
+        {"sheeprl_tpu/diagnostics/tracing.py": TRC_TRACING, **extra}
+    )
+
+
+def test_trc_positive_unknown_span_name():
+    source = """\
+def dispatch(tracer, group):
+    with tracer.span("serve-dipatch"):
+        pass
+    tracer.emit_complete("serve-scater", 0, 10)
+"""
+    findings = trc_pass.run(_trc_index({"sheeprl_tpu/serving/batcher.py": source}))
+    bad = sorted(f.message.split("`")[1] for f in findings if f.rule == "TRC501")
+    assert bad == ["serve-dipatch", "serve-scater"]
+
+
+def test_trc_negative_known_phases_dynamic_names_and_instants_clean():
+    source = """\
+import re
+
+def loop(diag, tracer, name):
+    with diag.span("rollout"):
+        pass
+    tracer.emit_complete("serve-dispatch", 0, 10, rows=4)
+    with tracer.span(name):          # dynamic name: not checkable
+        pass
+    tracer.instant("ckpt_promote")   # instants are events, not phases
+    re.match("x", "x").span()        # argless .span(): someone else's API
+"""
+    assert trc_pass.run(_trc_index({"sheeprl_tpu/serving/server.py": source})) == []
+
+
+def test_trc502_positive_inline_bucket_literals():
+    source = """\
+class PhaseStats:
+    def __init__(self):
+        self.buckets_ms = [1, 5, 25, 100]
+
+def build(cfg):
+    return PhaseStats2(buckets_ms=(1.0, 10.0, 100.0))
+"""
+    findings = trc_pass.run(_trc_index({"sheeprl_tpu/serving/server.py": source}))
+    assert [f.rule for f in findings] == ["TRC502", "TRC502"]
+
+
+def test_trc502_negative_config_sourced_and_constant_fallback():
+    source = """\
+DEFAULT_SLO_BUCKETS_MS = (1.0, 10.0, 100.0)   # ALL-CAPS fallback: allowed
+
+class PhaseStats:
+    def __init__(self, buckets_ms=None):
+        self.buckets_ms = tuple(float(b) for b in (buckets_ms or DEFAULT_SLO_BUCKETS_MS))
+
+def build(cfg):
+    return PhaseStats(buckets_ms=cfg.get("buckets_ms"))
+"""
+    assert trc_pass.run(_trc_index({"sheeprl_tpu/serving/server.py": source})) == []
+    # outside sheeprl_tpu/serving/ the bucket rule does not apply (training
+    # telemetry owns its own histograms)
+    elsewhere = "buckets_ms = [1, 2, 3]\n"
+    assert trc_pass.run(_trc_index({"sheeprl_tpu/diagnostics/foo.py": elsewhere})) == []
+
+
+def test_trc_missing_registry_is_itself_a_finding():
+    findings = trc_pass.run(
+        RepoIndex.from_sources({"sheeprl_tpu/serving/server.py": "x = 1\n"})
+    )
+    assert [f.rule for f in findings] == ["TRC501"]
+    assert "missing" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
 # baseline mechanics
 
 
@@ -619,7 +705,7 @@ def test_repo_lints_clean_within_budget(tmp_path):
     report = json.loads(out.read_text())
     assert report["findings"] == []
     assert report["stale_baseline_entries"] == []
-    assert set(report["families"]) == {"INS", "JIT", "CFG", "JRN", "ASY"}
+    assert set(report["families"]) == {"INS", "JIT", "CFG", "JRN", "ASY", "TRC"}
 
 
 def test_driver_rules_subset_and_catalog():
@@ -643,7 +729,7 @@ def test_driver_rules_subset_and_catalog():
         cwd=REPO_ROOT,
     )
     assert catalog.returncode == 0
-    for rule in ("INS001", "JIT101", "CFG201", "JRN301", "ASY401"):
+    for rule in ("INS001", "JIT101", "CFG201", "JRN301", "ASY401", "TRC501"):
         assert rule in catalog.stdout
 
 

@@ -2,7 +2,7 @@
 
 The framework half of ``tools/sheeprl_lint.py`` (the driver): structured
 :class:`Finding` records, the pass registry, and the JSON baseline that
-suppresses accepted findings.  Five pass families (one module each):
+suppresses accepted findings.  Six pass families (one module each):
 
 * **INS** (:mod:`lint.ins_pass`) — training loops stay wired into the
   diagnostics facade: ``diag.instrument`` dispatch, ``donate_argnums``
@@ -16,7 +16,10 @@ suppresses accepted findings.  Five pass families (one module each):
   name is declared in ``sheeprl_tpu/diagnostics/schema.py`` and documented;
 * **ASY** (:mod:`lint.asy_pass`) — split-phase env discipline: every
   ``step_async`` is matched by a ``step_wait`` before the next one, and the
-  shm-executor command bytes live in exactly one module.
+  shm-executor command bytes live in exactly one module;
+* **TRC** (:mod:`lint.trc_pass`) — trace hygiene: every literal span name
+  resolves to ``tracing.KNOWN_PHASES``, and serving histogram bucket
+  boundaries come from ``serving.slo.buckets_ms`` config, never inline.
 
 A finding's baseline key is ``(rule, file, message)`` — line numbers drift
 with unrelated edits, so they are display-only.  Every baseline entry carries
@@ -68,7 +71,7 @@ def get_passes() -> Dict[str, object]:
     """Family id -> pass module (each exposes ``run(index) -> List[Finding]``
     and a ``RULES`` catalog).  Imported lazily so the loader stays importable
     from the back-compat shim without pulling every pass."""
-    from lint import asy_pass, cfg_pass, ins_pass, jit_pass, jrn_pass
+    from lint import asy_pass, cfg_pass, ins_pass, jit_pass, jrn_pass, trc_pass
 
     return {
         "INS": ins_pass,
@@ -76,6 +79,7 @@ def get_passes() -> Dict[str, object]:
         "CFG": cfg_pass,
         "JRN": jrn_pass,
         "ASY": asy_pass,
+        "TRC": trc_pass,
     }
 
 

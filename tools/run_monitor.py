@@ -11,7 +11,11 @@ Two sources, same view:
   (``tools/serve.py``) is recognized by its ``sheeprl_serve_*`` family and
   renders the request panel instead (req/s, p50/p99 latency, batch width,
   queue depth, promotion counters — with an ``!! UNHEALTHY-CKPT`` banner
-  while the last checkpoint promotion was rejected).
+  while the last checkpoint promotion was rejected), plus the per-model
+  latency-breakdown panel (queue/dispatch/scatter p50·p99 and the SLO burn
+  gauge, with ``!! SLO-BURN`` past 1.0 and an ``!! SLOW-REQ`` line naming
+  the last journaled slow request) — both modes render it through the one
+  ``report.serving_latency_lines`` helper.
 
 Shows run identity and state, the latest metric interval (reward, SPS, env
 throughput — env-steps/s + fetch amortization — TFLOP/s, MFU, phase
@@ -55,6 +59,7 @@ from sheeprl_tpu.diagnostics.report import (  # noqa: E402
     format_bytes,
     format_event_line,
     no_recent_ckpt_banner,
+    serving_latency_lines,
     sessions_full_banner,
     stale_params_banner,
     status_block,
@@ -214,6 +219,42 @@ def endpoint_status(url: str) -> str:
                 if _model_value("sheeprl_serve_last_promote_rejected", model):
                     row.append("REJECTED-CKPT")
                 lines.append(f"model   {model}: " + " · ".join(row))
+        # the per-model latency-breakdown panel: synthesize journal-shaped
+        # metrics events from the {model="..."} series (plus the unlabeled
+        # aggregate as a "default" fallback) and feed the SAME
+        # report.serving_latency_lines helper the journal mode uses — one
+        # owner for the panel layout and the !! SLO-BURN / !! SLOW-REQ
+        # wording, so the two modes can never drift
+        latency_by_model: Dict[str, Dict[str, float]] = {}
+        for prom_name, telemetry_key in (
+            ("sheeprl_serve_queue_ms_p50", "Telemetry/serve/queue_ms_p50"),
+            ("sheeprl_serve_queue_ms_p99", "Telemetry/serve/queue_ms_p99"),
+            ("sheeprl_serve_dispatch_ms_p50", "Telemetry/serve/dispatch_ms_p50"),
+            ("sheeprl_serve_dispatch_ms_p99", "Telemetry/serve/dispatch_ms_p99"),
+            ("sheeprl_serve_scatter_ms_p50", "Telemetry/serve/scatter_ms_p50"),
+            ("sheeprl_serve_scatter_ms_p99", "Telemetry/serve/scatter_ms_p99"),
+            ("sheeprl_serve_slo_burn", "Telemetry/serve/slo_burn"),
+            ("sheeprl_serve_shed_wait_ms", "Telemetry/serve/shed_wait_ms"),
+        ):
+            labeled = [
+                (labels["model"], value)
+                for labels, value in metrics["_labels"].get(prom_name) or []
+                if labels.get("model") and len(labels) == 1
+            ]
+            if labeled:
+                for model, value in labeled:
+                    latency_by_model.setdefault(model, {})[telemetry_key] = value
+            elif metrics.get(prom_name) is not None:
+                latency_by_model.setdefault("default", {})[telemetry_key] = metrics[prom_name]
+        synthetic: List[Dict[str, Any]] = [
+            {"event": "metrics", "model": model, "metrics": values}
+            for model, values in latency_by_model.items()
+        ]
+        info_labels = info_sets[0][0] if info_sets else {}
+        slow_id = info_labels.get("last_slow_request_id")
+        if slow_id:
+            synthetic.append({"event": "slow_request", "request_id": slow_id})
+        lines.extend(serving_latency_lines(synthetic, live=True))
         sessions_active = metrics.get("sheeprl_sessions_active")
         if sessions_active is not None:
             sessions_capacity = metrics.get("sheeprl_sessions_capacity")

@@ -4,7 +4,11 @@
 Every trace the diagnostics tracer writes (``diagnostics.trace.enabled=True``)
 opens with a ``clock_sync`` instant whose ``epoch_t0_us`` anchors that file's
 monotonic ``ts`` values on the Unix epoch, and names the run id, rank and role
-(player / trainer / main).  This tool uses those anchors to:
+(player / trainer / main — or ``server`` for the serving tier's
+``trace_serve.json``, whose per-request ``serve-*`` spans then line up against
+training's phase spans on the same absolute clock: a training ``checkpoint``
+span is followed by a ``ckpt_promote`` instant on the serving track, listed in
+the report's instant-markers section).  This tool uses those anchors to:
 
 * merge traces written by different processes — a decoupled player + trainer
   pair, or the per-rank ``trace_rank{N}.json`` files of a multihost run — into
@@ -274,6 +278,31 @@ def phase_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return rows
 
 
+def instant_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Global instant markers on the merged timeline (``clock_sync`` anchors
+    excluded — they are bookkeeping, not run events).  ``ckpt_promote`` on the
+    serving track landing between training's ``checkpoint`` spans is the
+    cross-process story this table exists to tell."""
+    rows: List[Dict[str, Any]] = []
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") == "clock_sync":
+            continue
+        rows.append(
+            {
+                "name": str(e.get("name")),
+                "role": (e.get("args") or {}).get("role", "?"),
+                "ts_ms": round(int(e.get("ts", 0)) / 1e3, 3),
+                "args": {
+                    k: v
+                    for k, v in (e.get("args") or {}).items()
+                    if k not in ("role", "rank")
+                },
+            }
+        )
+    rows.sort(key=lambda r: r["ts_ms"])
+    return rows
+
+
 def format_phase_table(rows: List[Dict[str, Any]]) -> str:
     if not rows:
         return "no span events found"
@@ -305,6 +334,7 @@ def main() -> int:
         return 2
     merged, sources = merge_traces(files)
     rows = phase_table(merged)
+    instants = instant_table(merged)
 
     # run-state overlay: journals under run-dir args only (file args are
     # traces); each journal gets its own track on the merged timeline
@@ -339,7 +369,17 @@ def main() -> int:
             )
 
     if args.json:
-        print(json.dumps({"sources": sources, "phases": rows, "run_state_overlay": overlay_info}, indent=2))
+        print(
+            json.dumps(
+                {
+                    "sources": sources,
+                    "phases": rows,
+                    "instants": instants,
+                    "run_state_overlay": overlay_info,
+                },
+                indent=2,
+            )
+        )
     else:
         for src in sources:
             print(
@@ -353,6 +393,14 @@ def main() -> int:
             )
         print()
         print(format_phase_table(rows))
+        if instants:
+            print()
+            print("instant markers:")
+            for r in instants[:20]:
+                detail = " ".join(f"{k}={v}" for k, v in sorted(r["args"].items()))
+                print(f"  {r['ts_ms']:>12.3f} ms  [{r['role']}] {r['name']}  {detail}".rstrip())
+            if len(instants) > 20:
+                print(f"  ... {len(instants) - 20} more")
 
     if args.out:
         with open(args.out, "w", encoding="utf-8") as fp:
