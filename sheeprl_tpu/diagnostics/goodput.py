@@ -173,15 +173,19 @@ class GoodputMonitor:
     ) -> None:
         if self._opened:
             return
-        self._journal_fn = journal_fn
-        self._sync_fn = sync_fn
-        self._telemetry = telemetry
-        self._log_dir = str(log_dir) if log_dir else None
-        now = self._clock()
-        self._open_clock = now
-        self._state_entered_t = now
-        self._last_progress = now
-        self._opened = True
+        # publish under the monitor lock: the watchdog starts below and reads
+        # all of these; the lock (not thread-start ordering) is what makes
+        # open() safe to race with an early first heartbeat
+        with self._lock:
+            self._journal_fn = journal_fn
+            self._sync_fn = sync_fn
+            self._telemetry = telemetry
+            self._log_dir = str(log_dir) if log_dir else None
+            now = self._clock()
+            self._open_clock = now
+            self._state_entered_t = now
+            self._last_progress = now
+            self._opened = True
         if self.watchdog_enabled and self.heartbeat_s is not None and self.stall_threshold_s is not None:
             self._thread = threading.Thread(
                 target=self._watchdog_loop, name="sheeprl-stall-watchdog", daemon=True

@@ -179,7 +179,11 @@ class AsyncCheckpointWriter:
             kwargs = {"group": group} if group is not None else {}
             result = save_verified_checkpoint(path, snapshot, step=step, **kwargs)
         except Exception as err:
-            self.failed_total += 1
+            # stats mutate under the condition's lock (stats() reads there);
+            # the journal emission stays outside — fsync under a contended
+            # lock would stall submit()/drain()
+            with self._cond:
+                self.failed_total += 1
             self._journal(
                 "ckpt_end",
                 path=path,
@@ -195,14 +199,15 @@ class AsyncCheckpointWriter:
             )
             return
         now = time.time()
-        if self.last_end_t is not None:
-            self.last_interval_s = round(max(0.0, now - self.last_end_t), 3)
-        self.last_end_t = now
-        self.written_total += 1
-        self.write_seconds_total += result["write_ms"] / 1e3
-        self.last_write_ms = result["write_ms"]
-        self.last_step = result["step"]
-        self.last_path = result["path"]
+        with self._cond:
+            if self.last_end_t is not None:
+                self.last_interval_s = round(max(0.0, now - self.last_end_t), 3)
+            self.last_end_t = now
+            self.written_total += 1
+            self.write_seconds_total += result["write_ms"] / 1e3
+            self.last_write_ms = result["write_ms"]
+            self.last_step = result["step"]
+            self.last_path = result["path"]
         self._journal(
             "ckpt_end", blocking=False, status="ok", verified=True, queued_s=queued_s, **result
         )
@@ -240,13 +245,16 @@ class AsyncCheckpointWriter:
             self._thread = None
 
     def stats(self) -> Dict[str, Any]:
-        return {
-            "written_total": self.written_total,
-            "failed_total": self.failed_total,
-            "write_seconds_total": round(self.write_seconds_total, 3),
-            "last_write_ms": self.last_write_ms,
-            "last_step": self.last_step,
-            "last_path": self.last_path,
-            "last_end_t": self.last_end_t,
-            "last_interval_s": self.last_interval_s,
-        }
+        # one consistent snapshot: the worker publishes all write stats in a
+        # single locked block, so written_total/last_* never mix two writes
+        with self._cond:
+            return {
+                "written_total": self.written_total,
+                "failed_total": self.failed_total,
+                "write_seconds_total": round(self.write_seconds_total, 3),
+                "last_write_ms": self.last_write_ms,
+                "last_step": self.last_step,
+                "last_path": self.last_path,
+                "last_end_t": self.last_end_t,
+                "last_interval_s": self.last_interval_s,
+            }

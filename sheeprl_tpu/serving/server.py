@@ -189,6 +189,13 @@ class SloMonitor:
     def observe(self, total_ms: float) -> float:
         if self.target_ms is None:
             return 0.0
+        # state transitions decide under the lock; the journal write (and its
+        # fsync) happens after release — observe() runs on the batcher thread
+        # that snapshot()/healthz contend with, and the journal's own lock
+        # already serializes writers. One caller per monitor keeps the
+        # breach -> breach_end order on disk.
+        breach = None
+        breach_end = None
         with self._lock:
             self._window.append(float(total_ms) > self.target_ms)
             bad = sum(self._window)
@@ -201,30 +208,31 @@ class SloMonitor:
                     self.active = True
                     self.breaches_total += 1
                     self._active_since_t = time.time()
-                    if self._journal is not None:
-                        self._journal.write(
-                            "slo_breach",
-                            model=self.model,
-                            burn=round(burn, 4),
-                            target_ms=self.target_ms,
-                            objective=self.objective,
-                            window=len(self._window),
-                            confirm=self._confirm,
-                        )
-                        self._journal.sync()
+                    breach = {
+                        "model": self.model,
+                        "burn": round(burn, 4),
+                        "target_ms": self.target_ms,
+                        "objective": self.objective,
+                        "window": len(self._window),
+                        "confirm": self._confirm,
+                    }
             else:
                 self._breaches = 0
                 if self.active:
                     self.active = False
                     since = self._active_since_t
                     self._active_since_t = None
-                    if self._journal is not None:
-                        self._journal.write(
-                            "slo_breach_end",
-                            model=self.model,
-                            burn=round(burn, 4),
-                            breach_s=None if since is None else round(time.time() - since, 3),
-                        )
+                    breach_end = {
+                        "model": self.model,
+                        "burn": round(burn, 4),
+                        "breach_s": None if since is None else round(time.time() - since, 3),
+                    }
+        if self._journal is not None:
+            if breach is not None:
+                self._journal.write("slo_breach", **breach)
+                self._journal.sync()
+            if breach_end is not None:
+                self._journal.write("slo_breach_end", **breach_end)
         return burn
 
 
@@ -317,6 +325,11 @@ class PolicyService:
         self._compile_lock = threading.Lock()
         self._compiled: Dict[Tuple[int, bool], Callable] = {}
         self.compile_count = 0
+        # serving counters + self.info mutate from the watcher thread
+        # (promote/reject) and the batcher callback (_on_request_done) while
+        # snapshot() reads them from HTTP handler threads — one dedicated
+        # leaf lock, never held across dispatch, journal, or compile work
+        self._stats_lock = threading.Lock()
         self.promotions_total = 0
         self.rejections_total = 0
         self.last_promote_rejected = False
@@ -576,9 +589,10 @@ class PolicyService:
             return
         meta = dict(done.get("meta") or {})
         rid = done.get("request_id")
-        self.slow_requests_total += 1
-        self.last_slow_request_id = rid
-        self.info["last_slow_request_id"] = rid
+        with self._stats_lock:
+            self.slow_requests_total += 1
+            self.last_slow_request_id = rid
+            self.info["last_slow_request_id"] = rid
         if self._journal is not None:
             self._journal.write(
                 "slow_request",
@@ -620,9 +634,10 @@ class PolicyService:
             self._params_version += 1
             self.ckpt_step = int(step)
             self.ckpt_path = str(path)
-        self.promotions_total += 1
-        self.last_promote_rejected = False
-        self.info["ckpt_path"] = str(path)
+        with self._stats_lock:
+            self.promotions_total += 1
+            self.last_promote_rejected = False
+            self.info["ckpt_path"] = str(path)
         if self._journal is not None:
             self._journal.write(
                 "ckpt_promote", step=int(step), path=str(path), source=source,
@@ -635,8 +650,9 @@ class PolicyService:
         return True
 
     def reject(self, path: str, reason: str, anomalies: Optional[List[Dict[str, Any]]] = None) -> None:
-        self.rejections_total += 1
-        self.last_promote_rejected = True
+        with self._stats_lock:
+            self.rejections_total += 1
+            self.last_promote_rejected = True
         if self._journal is not None:
             self._journal.write(
                 "ckpt_reject",
@@ -677,10 +693,19 @@ class PolicyService:
         gauges/counters as the ``sheeprl_serve_*`` / ``sheeprl_sessions_*``
         families (schema-registered in ``diagnostics/schema.py``)."""
         stats = self.batcher.stats()
+        # one consistent copy of the promote/slow-request stats: the watcher
+        # and the batcher callback mutate them under the same lock, so a
+        # snapshot never pairs a new counter with a stale info dict
+        with self._stats_lock:
+            promotions_total = self.promotions_total
+            rejections_total = self.rejections_total
+            slow_requests_total = self.slow_requests_total
+            last_promote_rejected = self.last_promote_rejected
+            info = dict(self.info)
         gauges: Dict[str, Any] = {
             SERVE_GAUGE_PREFIX + "queue_depth": stats["queue_depth"],
             SERVE_GAUGE_PREFIX + "ckpt_step": self.ckpt_step,
-            SERVE_GAUGE_PREFIX + "last_promote_rejected": int(self.last_promote_rejected),
+            SERVE_GAUGE_PREFIX + "last_promote_rejected": int(last_promote_rejected),
         }
         for src, name in (
             ("latency_p50_ms", "latency_p50_ms"),
@@ -705,9 +730,9 @@ class PolicyService:
             "serve_dispatches_total": stats["dispatches_total"],
             "serve_request_errors_total": stats["errors_total"],
             "serve_shed_total": stats["shed_total"],
-            "serve_ckpt_promotions_total": self.promotions_total,
-            "serve_ckpt_rejections_total": self.rejections_total,
-            "serve_slow_requests_total": self.slow_requests_total,
+            "serve_ckpt_promotions_total": promotions_total,
+            "serve_ckpt_rejections_total": rejections_total,
+            "serve_slow_requests_total": slow_requests_total,
             "serve_slo_breaches_total": self.slo.breaches_total,
         }
         if self.sessions is not None:
@@ -721,7 +746,7 @@ class PolicyService:
             counters["serve_request_log_rows_total"] = rl["rows_total"]
             counters["serve_request_log_shards_total"] = rl["shards_total"]
         return {
-            "info": {k: v for k, v in self.info.items() if v is not None},
+            "info": {k: v for k, v in info.items() if v is not None},
             "gauges": gauges,
             "counters": counters,
             "batch_width_hist": stats["width_hist"],

@@ -40,8 +40,10 @@ encoding this repo's suite split and timeouts explicitly (VERDICT r4
   `tests/test_tools/test_lint.py` (the static-analysis framework itself).  The suite is preceded by the full
   `tools/sheeprl_lint.py` run (all pass families: INS instrumentation/
   donation wiring, JIT traced-body purity, CFG config contracts, JRN
-  journal/metric schemas, ASY async-env discipline — see howto/lint.md),
-  which must finish in well under 15 s and writes its JSON report to
+  journal/metric schemas, ASY async-env discipline, TRC trace-span/bucket
+  hygiene, LCK lock discipline & thread safety — see howto/lint.md),
+  which must finish in well under 15 s (`--jobs 4` runs the families on a
+  thread pool) and writes its JSON report to
   `logs/lint_report.json`; intentional findings are accepted via
   `python tools/sheeprl_lint.py --update-baseline` (every new baseline
   entry needs a one-line why).  ~8 min on one CPU core.  Budget: 25 min.
@@ -96,12 +98,15 @@ def run_suite(name: str, fail_fast: bool) -> int:
     if name == "unit":
         # fast AST-only pre-step: the full static analyzer (instrumentation
         # wiring, jit purity, config contracts, journal schemas, async
-        # discipline — the invariants the diagnostics suite then tests
-        # behaviorally).  JSON artifact lands next to the run logs.
+        # discipline, trace hygiene, lock discipline — the invariants the
+        # diagnostics/serving suites then test behaviorally).  JSON artifact
+        # lands next to the run logs.
         lint = subprocess.run(
             [
                 sys.executable,
                 os.path.join(REPO_ROOT, "tools", "sheeprl_lint.py"),
+                "--jobs",
+                "4",
                 "--out",
                 os.path.join(REPO_ROOT, "logs", "lint_report.json"),
             ],

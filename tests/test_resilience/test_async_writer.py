@@ -189,3 +189,26 @@ def test_bench_interval_goodput_async_beats_blocking():
     out = measure_recovery(state_mb=8.0, kill_drill=False)
     assert out["async_critical_path_ms"] < out["blocking_write_ms"]
     assert out["interval_goodput"]["async"] > out["interval_goodput"]["blocking"]
+
+
+def test_write_stats_publish_under_the_cond_and_journal_outside_it(tmp_path):
+    """LCK regression: the worker used to mutate written_total/last_* with no
+    lock while stats() read them from the main thread, and a locked journal
+    emission would stall submit()/drain() behind the checkpoint fsync.  The
+    probe runs ON the worker thread: at emission time the condition's lock
+    must not be owned by the emitter."""
+    emissions = []
+
+    def probing_journal(kind, **fields):
+        # Condition._is_owned: does the CALLING thread hold the lock?
+        assert not writer._cond._is_owned(), f"journal `{kind}` emitted under _cond"
+        emissions.append(kind)
+
+    writer = AsyncCheckpointWriter(journal_fn=probing_journal)
+    for step in (8, 16):
+        writer.submit(str(tmp_path / f"ckpt_{step}_0.ckpt"), _state(step))
+    writer.close()
+    assert emissions == ["ckpt_begin", "ckpt_end", "ckpt_begin", "ckpt_end"]
+    stats = writer.stats()
+    assert stats["written_total"] == 2 and stats["failed_total"] == 0
+    assert stats["last_step"] == 16

@@ -1,0 +1,106 @@
+"""Regression tests for the LCK-driven thread-safety fixes in the serving
+tier (see ``howto/lint.md``, LCK rule catalog):
+
+* ``SloMonitor.observe`` decides breach transitions under its lock but
+  journals AFTER releasing it (LCK504 — fsync latency under a lock the
+  batcher thread contends with);
+* the promote/reject/slow-request counters and ``self.info`` mutate under
+  ``PolicyService._stats_lock`` so concurrent watcher promotions, batcher
+  callbacks, and ``snapshot()`` readers neither lose increments nor tear
+  the info dict (LCK501).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from sheeprl_tpu.serving.server import PolicyService, SloMonitor
+
+
+class _LockProbeJournal:
+    """Asserts the SLO monitor's lock is RELEASED at journal-write time —
+    the regression: emissions used to run inside ``with self._lock``."""
+
+    def __init__(self):
+        self.kinds = []
+        self.monitor = None  # set after the monitor is built (it takes `journal=`)
+        self.lock_held_at_write = False
+
+    def write(self, kind, **fields):
+        assert self.monitor is not None
+        if self.monitor._lock.acquire(blocking=False):
+            self.monitor._lock.release()
+        else:
+            self.lock_held_at_write = True
+        self.kinds.append(kind)
+
+    def sync(self):
+        pass
+
+
+def test_slo_breach_journals_outside_the_monitor_lock():
+    journal = _LockProbeJournal()
+    mon = SloMonitor(target_ms=10.0, objective=0.5, window=4, confirm=1, journal=journal, model="m")
+    journal.monitor = mon
+    for _ in range(4):
+        mon.observe(100.0)  # every observation breaches: burn > 1, breach fires
+    for _ in range(8):
+        mon.observe(1.0)  # recovery: breach_end fires
+    assert journal.kinds[0] == "slo_breach" and "slo_breach_end" in journal.kinds
+    assert not journal.lock_held_at_write, "journal emission ran under SloMonitor._lock"
+
+
+def test_slo_transition_still_atomic_under_concurrent_observers():
+    # the lock still covers the state transition itself: many racing
+    # observers produce exactly one breach and at most one recovery
+    journal = _LockProbeJournal()
+    mon = SloMonitor(target_ms=10.0, objective=0.5, window=64, confirm=1, journal=journal, model="m")
+    journal.monitor = mon
+    threads = [
+        threading.Thread(target=lambda: [mon.observe(100.0) for _ in range(50)])
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert mon.breaches_total == 1
+    assert journal.kinds.count("slo_breach") == 1
+    assert not journal.lock_held_at_write
+
+
+def test_promote_reject_counters_survive_concurrent_mutation(fake_handle):
+    """Lost-update regression: unlocked ``+=`` from racing watcher-style
+    threads used to drop increments that ``snapshot()`` then exported."""
+    svc = PolicyService(fake_handle, {"batch_buckets": [2]}, aot=False)
+    rounds = 200
+
+    def promoter():
+        for _ in range(rounds):
+            svc.promote({"w": np.float32(2.0)}, step=1, path="ckpt_1_0.ckpt")
+
+    def rejecter():
+        for _ in range(rounds):
+            svc.reject("ckpt_bad.ckpt", "synthetic")
+
+    snapshots = []
+
+    def reader():
+        for _ in range(rounds):
+            snapshots.append(svc.snapshot())
+
+    threads = [threading.Thread(target=fn) for fn in (promoter, promoter, rejecter, reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final = svc.snapshot()
+    assert final["counters"]["serve_ckpt_promotions_total"] == 2 * rounds
+    assert final["counters"]["serve_ckpt_rejections_total"] == rounds
+    assert final["info"]["ckpt_path"] == "ckpt_1_0.ckpt"
+    # every mid-race snapshot exported an internally consistent info dict:
+    # ckpt_path is absent (pre-promotion) or the promoted path, never torn
+    for snap in snapshots:
+        assert snap["info"].get("ckpt_path") in (None, "ckpt_1_0.ckpt")

@@ -25,7 +25,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 sys.path.insert(0, str(REPO_ROOT / "tools"))
 
 from lint import Finding, apply_baseline, load_baseline, run_passes, write_baseline  # noqa: E402
-from lint import asy_pass, cfg_pass, ins_pass, jit_pass, jrn_pass, trc_pass  # noqa: E402
+from lint import asy_pass, cfg_pass, ins_pass, jit_pass, jrn_pass, lck_pass, trc_pass  # noqa: E402
 from lint.loader import RepoIndex  # noqa: E402
 
 
@@ -619,6 +619,410 @@ def test_trc_missing_registry_is_itself_a_finding():
 
 
 # ---------------------------------------------------------------------------
+# LCK — lock discipline & thread safety
+
+
+LCK501_RACY = """\
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self.count += 1
+
+    def stats(self):
+        return {"count": self.count}
+"""
+
+LCK501_GUARDED = LCK501_RACY.replace(
+    """\
+    def _loop(self):
+        while True:
+            self.count += 1
+
+    def stats(self):
+        return {"count": self.count}
+""",
+    """\
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def stats(self):
+        with self._lock:
+            return {"count": self.count}
+""",
+)
+
+
+def test_lck501_positive_unguarded_shared_counter():
+    index = RepoIndex.from_sources({"sheeprl_tpu/serving/worker.py": LCK501_RACY})
+    findings = lck_pass.run(index)
+    assert [f.rule for f in findings] == ["LCK501"]
+    assert "Worker.count" in findings[0].message
+
+
+def test_lck501_negative_guarded_counter_clean():
+    index = RepoIndex.from_sources({"sheeprl_tpu/serving/worker.py": LCK501_GUARDED})
+    assert lck_pass.run(index) == []
+
+
+def test_lck501_negative_main_only_publication():
+    # assign-before-thread-start safe publication: only main ever writes,
+    # the thread only reads — the facade.open()/monitor.open() pattern
+    source = """\
+import threading
+
+class Facade:
+    def __init__(self):
+        self.journal = None
+        threading.Thread(target=self._beat, daemon=True).start()
+
+    def open(self, journal):
+        self.journal = journal
+
+    def _beat(self):
+        if self.journal is not None:
+            self.journal.write("beat")
+"""
+    index = RepoIndex.from_sources({"sheeprl_tpu/diagnostics/facade.py": source})
+    assert lck_pass.run(index) == []
+
+
+LCK502_ESCAPED = """\
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self.count += 1
+
+    def reset(self):
+        self.count = 0
+
+    def peek(self):
+        return self.count
+"""
+
+
+def test_lck502_positive_escaped_write():
+    index = RepoIndex.from_sources({"sheeprl_tpu/serving/worker.py": LCK502_ESCAPED})
+    findings = lck_pass.run(index)
+    assert [f.rule for f in findings] == ["LCK502"]
+    assert "Worker.reset" in findings[0].message  # the escaped WRITE, not peek
+
+
+def test_lck502_negative_escaped_scalar_read_tolerated():
+    # drop the escaped write: the bare read in peek() is the GIL-atomic
+    # monitoring pattern (double-checked caches, /healthz gauges) and legal
+    source = LCK502_ESCAPED.replace("    def reset(self):\n        self.count = 0\n\n", "")
+    index = RepoIndex.from_sources({"sheeprl_tpu/serving/worker.py": source})
+    assert lck_pass.run(index) == []
+
+
+def test_lck502_negative_extra_lock_still_agrees():
+    # an access holding the agreed lock PLUS another lock (compile path
+    # taking the params lock inside the compile lock) is not a split guard
+    source = """\
+import threading
+
+class Service:
+    def __init__(self):
+        self._params_lock = threading.Lock()
+        self._compile_lock = threading.Lock()
+        self.version = 0
+        threading.Thread(target=self._promote_loop, daemon=True).start()
+
+    def _promote_loop(self):
+        with self._params_lock:
+            self.version += 1
+
+    def compile(self):
+        with self._compile_lock:
+            with self._params_lock:
+                return self.version
+"""
+    index = RepoIndex.from_sources({"sheeprl_tpu/serving/worker.py": source})
+    assert lck_pass.run(index) == []
+
+
+def test_lck503_positive_unlocked_runjournal_and_foreign_fp():
+    source = """\
+import os
+import threading
+
+class RunJournal:
+    def __init__(self, fp):
+        self._lock = threading.Lock()
+        self._fp = fp
+
+    def write(self, kind):
+        self._fp.write(kind)
+
+class Telemetry:
+    def __init__(self, journal):
+        self._journal = journal
+        threading.Thread(target=self._beat, daemon=True).start()
+
+    def _beat(self):
+        self._journal._fp.write("beat")
+"""
+    index = RepoIndex.from_sources({"sheeprl_tpu/diagnostics/journal.py": source})
+    rules = [f.rule for f in lck_pass.run(index)]
+    assert rules.count("LCK503") == 2
+
+
+def test_lck503_negative_locked_api_clean():
+    source = """\
+import os
+import threading
+
+class RunJournal:
+    def __init__(self, fp):
+        self._lock = threading.Lock()
+        self._fp = fp
+
+    def write(self, kind):
+        with self._lock:
+            self._fp.write(kind)
+            os.fsync(self._fp.fileno())
+
+class Telemetry:
+    def __init__(self, journal):
+        self._journal = journal
+        threading.Thread(target=self._beat, daemon=True).start()
+
+    def _beat(self):
+        self._journal.write("beat")
+"""
+    index = RepoIndex.from_sources({"sheeprl_tpu/diagnostics/journal.py": source})
+    assert lck_pass.run(index) == []
+
+
+def test_lck504_positive_blocking_and_emission_under_contended_lock():
+    source = """\
+import time
+import threading
+
+class Monitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._journal_fn = print
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            time.sleep(1.0)
+            self._journal_fn("heartbeat")
+"""
+    index = RepoIndex.from_sources({"sheeprl_tpu/diagnostics/mon.py": source})
+    findings = lck_pass.run(index)
+    assert [f.rule for f in findings] == ["LCK504", "LCK504"]
+    messages = "\n".join(f.message for f in findings)
+    assert "time.sleep" in messages and "heartbeat" in messages
+
+
+def test_lck504_negative_uncontended_lock_exempt():
+    # same shape but the module has NO thread entries: a lock only the main
+    # path takes cannot stall another thread (the health-monitor pattern)
+    source = """\
+import time
+import threading
+
+class Health:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._journal_fn = print
+
+    def observe(self):
+        with self._lock:
+            time.sleep(0.01)
+            self._journal_fn("fault_injection")
+"""
+    index = RepoIndex.from_sources({"sheeprl_tpu/diagnostics/health2.py": source})
+    assert lck_pass.run(index) == []
+
+
+def test_lck504_negative_emission_outside_lock_clean():
+    source = """\
+import threading
+
+class Monitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._journal_fn = print
+        self.beats = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self.beats += 1
+        self._journal_fn("heartbeat")
+"""
+    index = RepoIndex.from_sources({"sheeprl_tpu/diagnostics/mon.py": source})
+    assert lck_pass.run(index) == []
+
+
+def test_lck505_positive_unbounded_waits():
+    source = """\
+import threading
+
+class Waiter:
+    def __init__(self):
+        self._ready = threading.Event()
+        self._cv = threading.Condition()
+
+    def block(self):
+        self._ready.wait()
+
+    def block_zero(self):
+        self._ready.wait(0)
+
+    def cond_no_loop(self):
+        with self._cv:
+            self._cv.wait(timeout=1.0)
+"""
+    index = RepoIndex.from_sources({"sheeprl_tpu/serving/waiter.py": source})
+    assert [f.rule for f in lck_pass.run(index)] == ["LCK505", "LCK505", "LCK505"]
+
+
+def test_lck505_negative_bounded_and_predicate_waits():
+    source = """\
+import threading
+
+class Waiter:
+    def __init__(self):
+        self._ready = threading.Event()
+        self._cv = threading.Condition()
+        self.queue = []
+
+    def poll(self, timeout_s):
+        self._ready.wait(0.5)
+        self._ready.wait(timeout_s)     # variable timeout: assumed positive
+
+    def cond_loop(self):
+        with self._cv:
+            while not self.queue:
+                self._cv.wait(timeout=1.0)
+
+    def foreign(self, proc, req):
+        proc.wait()                     # subprocess.Popen: not an Event
+        req.event.wait()                # unknown receiver class: skipped
+"""
+    index = RepoIndex.from_sources({"sheeprl_tpu/serving/waiter.py": source})
+    assert lck_pass.run(index) == []
+
+
+def test_lck_messages_carry_no_line_numbers():
+    import re
+
+    for fixture in (LCK501_RACY, LCK502_ESCAPED):
+        findings = lck_pass.run(
+            RepoIndex.from_sources({"sheeprl_tpu/serving/worker.py": fixture})
+        )
+        assert findings
+        for finding in findings:
+            assert not re.search(r"line \d", finding.message), finding.message
+
+
+# -- red mutations: one per LCK rule, on the REAL repo sources --------------
+
+
+def _mutated_module(relpath: str, old: str, new: str) -> RepoIndex:
+    source = (REPO_ROOT / relpath).read_text()
+    assert old in source, f"mutation anchor vanished from {relpath}: {old!r}"
+    return RepoIndex.from_sources({relpath: source.replace(old, new)})
+
+
+def test_mutation_lck501_unguarding_async_writer_stats_goes_red():
+    index = _mutated_module(
+        "sheeprl_tpu/resilience/async_writer.py", "with self._cond:", "if True:"
+    )
+    assert "LCK501" in {f.rule for f in lck_pass.run(index)}
+
+
+def test_mutation_lck502_unguarding_note_progress_goes_red():
+    index = _mutated_module(
+        "sheeprl_tpu/diagnostics/goodput.py",
+        "with self._lock:\n            now = self._clock()\n            self._last_progress = now",
+        "if True:\n            now = self._clock()\n            self._last_progress = now",
+    )
+    assert "LCK502" in {f.rule for f in lck_pass.run(index)}
+
+
+def test_mutation_lck503_deleting_runjournal_write_lock_goes_repo_red():
+    # the ISSUE's red-mutation check: drop RunJournal.write's lock
+    # acquisition and the repo must stop linting clean — the finding is
+    # active (no baseline entry covers LCK503)
+    index = _mutated_module(
+        "sheeprl_tpu/diagnostics/journal.py",
+        "with self._lock:\n            if self._closed:\n                return\n            self.last_write_t = time.time()",
+        "if True:\n            if self._closed:\n                return\n            self.last_write_t = time.time()",
+    )
+    findings = lck_pass.run(index)
+    assert "LCK503" in {f.rule for f in findings}
+    baseline = load_baseline(str(REPO_ROOT / "tools" / "lint" / "baseline.json"))
+    active, _, _ = apply_baseline(findings, baseline)
+    assert any(f.rule == "LCK503" for f in active)
+
+
+def test_mutation_lck504_sleep_under_writer_cond_goes_red():
+    index = _mutated_module(
+        "sheeprl_tpu/resilience/async_writer.py",
+        "self.failed_total += 1",
+        "self.failed_total += 1; time.sleep(0.5)",
+    )
+    assert "LCK504" in {f.rule for f in lck_pass.run(index)}
+
+
+def test_mutation_lck505_argless_watchdog_wait_goes_red():
+    index = _mutated_module(
+        "sheeprl_tpu/diagnostics/goodput.py",
+        "self._stop.wait(self.heartbeat_s)",
+        "self._stop.wait()",
+    )
+    assert "LCK505" in {f.rule for f in lck_pass.run(index)}
+
+
+def test_repo_sources_lint_lck_clean_in_process():
+    # the fix sites themselves (server.py stats lock, SloMonitor emissions,
+    # async writer stats, goodput open publication) stay clean in-process —
+    # only the 5 baselined goodput stall-ordering LCK504s may surface
+    index = RepoIndex.from_fs(REPO_ROOT)
+    findings = lck_pass.run(index)
+    baseline = load_baseline(str(REPO_ROOT / "tools" / "lint" / "baseline.json"))
+    active, suppressed, _ = apply_baseline(findings, baseline)
+    assert active == []
+    assert len(suppressed) == 5
+
+
+def test_run_passes_jobs_parallel_matches_sequential():
+    sources = {
+        "sheeprl_tpu/serving/worker.py": LCK501_RACY,
+        "sheeprl_tpu/configs/algo/default.yaml": CFG_YAML,
+        "sheeprl_tpu/foo.py": CFG_CONSUMER,
+    }
+    index = RepoIndex.from_sources(sources)
+    sequential = run_passes(index)
+    assert run_passes(index, jobs=4) == sequential
+    # --rules subset semantics survive the thread pool
+    subset = run_passes(index, families=["LCK"], jobs=4)
+    assert subset == run_passes(index, families=["LCK"])
+    assert {f.rule for f in subset} == {"LCK501"}
+
+
+# ---------------------------------------------------------------------------
 # baseline mechanics
 
 
@@ -705,7 +1109,7 @@ def test_repo_lints_clean_within_budget(tmp_path):
     report = json.loads(out.read_text())
     assert report["findings"] == []
     assert report["stale_baseline_entries"] == []
-    assert set(report["families"]) == {"INS", "JIT", "CFG", "JRN", "ASY", "TRC"}
+    assert set(report["families"]) == {"INS", "JIT", "CFG", "JRN", "ASY", "TRC", "LCK"}
 
 
 def test_driver_rules_subset_and_catalog():
@@ -729,7 +1133,7 @@ def test_driver_rules_subset_and_catalog():
         cwd=REPO_ROOT,
     )
     assert catalog.returncode == 0
-    for rule in ("INS001", "JIT101", "CFG201", "JRN301", "ASY401", "TRC501"):
+    for rule in ("INS001", "JIT101", "CFG201", "JRN301", "ASY401", "TRC501", "LCK501"):
         assert rule in catalog.stdout
 
 

@@ -19,7 +19,13 @@ suppresses accepted findings.  Six pass families (one module each):
   shm-executor command bytes live in exactly one module;
 * **TRC** (:mod:`lint.trc_pass`) — trace hygiene: every literal span name
   resolves to ``tracing.KNOWN_PHASES``, and serving histogram bucket
-  boundaries come from ``serving.slo.buckets_ms`` config, never inline.
+  boundaries come from ``serving.slo.buckets_ms`` config, never inline;
+* **LCK** (:mod:`lint.lck_pass`) — lock discipline for the threaded runtime:
+  a per-module thread model (Thread targets, HTTP ``do_*`` handlers,
+  escaped callbacks) plus a call graph classifies shared attributes and
+  requires every shared access to sit under one lock; journal emissions and
+  blocking calls under contended monitor locks are flagged, and
+  ``Event``/``Condition`` waits must not park forever.
 
 A finding's baseline key is ``(rule, file, message)`` — line numbers drift
 with unrelated edits, so they are display-only.  Every baseline entry carries
@@ -71,7 +77,7 @@ def get_passes() -> Dict[str, object]:
     """Family id -> pass module (each exposes ``run(index) -> List[Finding]``
     and a ``RULES`` catalog).  Imported lazily so the loader stays importable
     from the back-compat shim without pulling every pass."""
-    from lint import asy_pass, cfg_pass, ins_pass, jit_pass, jrn_pass, trc_pass
+    from lint import asy_pass, cfg_pass, ins_pass, jit_pass, jrn_pass, lck_pass, trc_pass
 
     return {
         "INS": ins_pass,
@@ -80,6 +86,7 @@ def get_passes() -> Dict[str, object]:
         "JRN": jrn_pass,
         "ASY": asy_pass,
         "TRC": trc_pass,
+        "LCK": lck_pass,
     }
 
 
@@ -91,14 +98,27 @@ def rule_catalog() -> Dict[str, str]:
     return catalog
 
 
-def run_passes(index: RepoIndex, families: Optional[List[str]] = None) -> List[Finding]:
+def run_passes(
+    index: RepoIndex, families: Optional[List[str]] = None, jobs: int = 1
+) -> List[Finding]:
+    """Run the selected pass families over ``index``.  ``jobs > 1`` runs the
+    families on a thread pool — they are independent read-only walks over the
+    parsed AST/YAML corpus, and the final sort makes the output order
+    identical to a sequential run."""
     passes = get_passes()
     selected = list(passes) if not families else [f for f in passes if f in families]
     findings: List[Finding] = []
     for path, message in index.parse_errors:
         findings.append(Finding("LINT000", "error", path, 1, message))
-    for family in selected:
-        findings.extend(passes[family].run(index))
+    if jobs > 1 and len(selected) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(jobs, len(selected))) as pool:
+            for batch in pool.map(lambda family: passes[family].run(index), selected):
+                findings.extend(batch)
+    else:
+        for family in selected:
+            findings.extend(passes[family].run(index))
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
     return findings
 

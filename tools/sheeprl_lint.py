@@ -2,7 +2,7 @@
 """sheeprl-lint: whole-repo static analysis for jit purity, config contracts
 and journal/protocol schemas.
 
-Five import-free AST+YAML pass families over ``sheeprl_tpu/`` (see
+Import-free AST+YAML pass families over ``sheeprl_tpu/`` (see
 ``howto/lint.md`` for the full rule catalog):
 
 * **INS** — training loops dispatch through ``diag.instrument`` and declare
@@ -14,7 +14,12 @@ Five import-free AST+YAML pass families over ``sheeprl_tpu/`` (see
 * **JRN** — journal event kinds and ``/metrics`` names are declared in
   ``sheeprl_tpu/diagnostics/schema.py`` and documented;
 * **ASY** — split-phase env discipline (async/wait pairing, single-module
-  command bytes).
+  command bytes);
+* **TRC** — trace hygiene (span names resolve to ``KNOWN_PHASES``, SLO
+  bucket boundaries come from config);
+* **LCK** — lock discipline for the threaded runtime (shared attributes
+  under one lock, no blocking/journal I/O under contended monitor locks,
+  no unbounded ``Event``/``Condition`` waits).
 
 Exit code is non-zero when any finding is not suppressed by the baseline.
 Wired into ``tests/run_tests.py`` as the unit-suite pre-step.
@@ -22,6 +27,7 @@ Wired into ``tests/run_tests.py`` as the unit-suite pre-step.
 Usage:
     python tools/sheeprl_lint.py                      # all passes, text
     python tools/sheeprl_lint.py --rules JIT,CFG      # subset
+    python tools/sheeprl_lint.py --jobs 4             # families in parallel
     python tools/sheeprl_lint.py --format json        # machine-readable
     python tools/sheeprl_lint.py --out report.json    # JSON artifact (always)
     python tools/sheeprl_lint.py --update-baseline    # accept current findings
@@ -72,6 +78,13 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--out", default=None, help="also write the JSON report here")
     parser.add_argument("--root", default=REPO_ROOT, help="repo root to lint")
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        help="run pass families on N threads (they are independent read-only "
+        "walks over the parsed corpus; output is identical to --jobs 1)",
+    )
     parser.add_argument("--list-rules", action="store_true", help="print the rule catalog")
     args = parser.parse_args(argv)
 
@@ -89,7 +102,7 @@ def main(argv=None) -> int:
 
     t0 = time.monotonic()
     index = RepoIndex.from_fs(args.root)
-    findings = run_passes(index, families)
+    findings = run_passes(index, families, jobs=max(1, args.jobs))
     elapsed = time.monotonic() - t0
 
     baseline = load_baseline(args.baseline)
